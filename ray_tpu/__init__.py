@@ -1,0 +1,93 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+The programming model of Ray — tasks, actors, objects, placement
+groups, and the ML libraries on top — re-designed for TPU hosts and
+pods: scheduling understands chips/slices as gang resources, the
+collective plane is XLA programs over an ICI mesh (not NCCL), and the
+training stack is jit/pjit/shard_map-first.
+
+Public surface parity tracked against the reference's python/ray/
+__init__.py: init, shutdown, remote, get, put, wait, kill, cancel,
+get_actor, ObjectRef, actor/task options, cluster introspection.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from . import exceptions
+from ._private.worker import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    free,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    wait,
+)
+from .actor import ActorClass, ActorHandle
+from .object_ref import ObjectRef
+from .remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+
+def remote(*args, **kwargs):
+    """Turn a function into a RemoteFunction or a class into an ActorClass.
+
+    Usable bare (`@remote`) or with options (`@remote(num_tpus=1)`).
+    Parity: ray.remote (python/ray/_private/worker.py:3407).
+    """
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        if callable(target):
+            return RemoteFunction(target, kwargs)
+        raise TypeError("@remote requires a function or class")
+
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return wrap(args[0])
+    if args:
+        raise TypeError("@remote with arguments must use keyword options, e.g. @remote(num_cpus=2)")
+    return wrap
+
+
+def method(**kwargs):
+    """Decorator for actor methods carrying default options (ray.method parity)."""
+
+    def decorator(fn):
+        fn.__ray_method_options__ = kwargs
+        return fn
+
+    return decorator
+
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "method",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "free",
+    "get_actor",
+    "available_resources",
+    "cluster_resources",
+    "nodes",
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "exceptions",
+]

@@ -1,0 +1,373 @@
+"""Core client: the per-process endpoint talking to the control hub.
+
+This is the analogue of the reference's CoreWorker (reference:
+src/ray/core_worker/core_worker.h:166) — one instance per driver or
+worker process. It owns:
+  - the hub connection + a reader thread that demultiplexes inbound
+    messages (task assignments vs request replies),
+  - the local view of the shm object store,
+  - an inline-object cache (objects are immutable, so caching is safe).
+
+Both the driver and workers use this same class; workers additionally
+run an executor loop (worker_process.py) fed from `task_queue`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from multiprocessing.connection import Client as MpClient
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import exceptions
+from . import protocol as P
+from .ids import ActorID, ObjectID, TaskID
+from .object_store import INLINE_THRESHOLD, ShmObjectStore
+from .serialization import dumps_inline, loads_inline
+
+
+class CoreClient:
+    def __init__(self, hub_addr: str, session_dir: str, role: str, worker_id: str):
+        self.role = role
+        self.worker_id = worker_id
+        self.session_dir = session_dir
+        self.store = ShmObjectStore(session_dir)
+        self.conn = MpClient(hub_addr, family="AF_UNIX")
+        self._send_lock = threading.Lock()
+        self._send_buf: List[tuple] = []
+        self._buf_evt = threading.Event()
+        self._req_counter = itertools.count()
+        self._pending: Dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._obj_cache: Dict[bytes, Any] = {}
+        self._obj_cache_lock = threading.Lock()
+        self._seen_fns: Dict[str, Any] = {}
+        self.task_queue: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self.send(P.HELLO, {"role": role, "worker_id": worker_id, "pid": os.getpid()})
+        self._reader = threading.Thread(target=self._read_loop, daemon=True, name="core-client-reader")
+        self._reader.start()
+
+        self._flusher = threading.Thread(target=self._flush_loop, daemon=True, name="core-client-flusher")
+        self._flusher.start()
+
+    # ------------------------------------------------------------------ wire
+    #
+    # Two send paths: `send` (immediate, flushes any buffered messages first
+    # so total order is preserved) and `send_async` (buffered). Buffering
+    # coalesces submit storms into one syscall + one hub wakeup per batch —
+    # this matters because the hub thread shares the driver's GIL; without
+    # batching every message pays a GIL handoff (~sys.getswitchinterval()).
+    def send(self, msg_type: str, payload: dict) -> None:
+        with self._send_lock:
+            if self._send_buf:
+                buf, self._send_buf = self._send_buf, []
+                buf.append((msg_type, payload))
+                self.conn.send_bytes(dumps_inline(("batch", buf)))
+            else:
+                self.conn.send_bytes(dumps_inline((msg_type, payload)))
+
+    def send_async(self, msg_type: str, payload: dict) -> None:
+        with self._send_lock:
+            self._send_buf.append((msg_type, payload))
+            n = len(self._send_buf)
+            if n >= 128:
+                buf, self._send_buf = self._send_buf, []
+                self.conn.send_bytes(dumps_inline(("batch", buf)))
+                return
+        if n == 1:
+            self._buf_evt.set()
+
+    def flush(self) -> None:
+        with self._send_lock:
+            if self._send_buf:
+                buf, self._send_buf = self._send_buf, []
+                self.conn.send_bytes(dumps_inline(("batch", buf)))
+
+    def _flush_loop(self) -> None:
+        # Catches stray buffered messages ~0.5ms after the burst ends.
+        while not self._closed:
+            self._buf_evt.wait()
+            self._buf_evt.clear()
+            time.sleep(0.0005)
+            try:
+                self.flush()
+            except (OSError, BrokenPipeError):
+                return
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                blob = self.conn.recv_bytes()
+                msg_type, payload = loads_inline(blob)
+                if msg_type == P.REPLY:
+                    req_id = payload["req_id"]
+                    with self._pending_lock:
+                        fut = self._pending.pop(req_id, None)
+                    if fut is not None:
+                        fut.set_result(payload)
+                else:
+                    # Task assignment (worker role) or control message.
+                    self.task_queue.put((msg_type, payload))
+        except (EOFError, OSError):
+            self._closed = True
+            with self._pending_lock:
+                pending, self._pending = self._pending, {}
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("hub connection lost"))
+            self.task_queue.put((P.KILL, {}))
+
+    def request(self, msg_type: str, payload: dict, timeout: Optional[float] = None) -> dict:
+        req_id = next(self._req_counter)
+        fut: Future = Future()
+        with self._pending_lock:
+            self._pending[req_id] = fut
+        payload = dict(payload, req_id=req_id)
+        self.send(msg_type, payload)
+        return fut.result(timeout=timeout)
+
+    # --------------------------------------------------------------- objects
+    def put_value(self, obj: Any, object_id: Optional[ObjectID] = None) -> ObjectID:
+        oid = object_id or ObjectID.generate()
+        kind, payload, size = self.encode_value(oid, obj)
+        self.send_async(P.PUT, {"object_id": oid.binary(), "kind": kind, "payload": payload, "size": size})
+        if kind == P.VAL_SHM:
+            # cache the deserialized original to avoid a re-map on local get
+            with self._obj_cache_lock:
+                self._obj_cache[oid.binary()] = obj
+        return oid
+
+    def encode_value(self, oid: ObjectID, obj: Any) -> Tuple[str, Any, int]:
+        """Encode a value for transport: inline bytes or shm segment name."""
+        from .serialization import dumps_oob
+
+        header, buffers = dumps_oob(obj)
+        nbytes = len(header) + sum(b.raw().nbytes for b in buffers)
+        if nbytes < INLINE_THRESHOLD:
+            if buffers:
+                blob = dumps_inline((header, [b.raw().tobytes() for b in buffers]))
+            else:
+                blob = dumps_inline((header, []))
+            return P.VAL_INLINE, blob, nbytes
+        name = oid.hex()
+        self.store.put_raw(name, header, [b.raw() for b in buffers])
+        return P.VAL_SHM, name, nbytes
+
+    def decode_value(self, oid_bytes: bytes, kind: str, payload: Any) -> Any:
+        if kind == P.VAL_INLINE:
+            header, bufs = loads_inline(payload)
+            from .serialization import loads_oob
+
+            return loads_oob(header, bufs)
+        if kind == P.VAL_SHM:
+            return self.store.get(payload)
+        if kind == P.VAL_ERROR:
+            err = loads_inline(payload)
+            raise err
+        raise ValueError(f"unknown value kind {kind}")
+
+    def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        out: Dict[bytes, Any] = {}
+        missing = []
+        with self._obj_cache_lock:
+            for oid in object_ids:
+                if oid.binary() in self._obj_cache:
+                    out[oid.binary()] = self._obj_cache[oid.binary()]
+                else:
+                    missing.append(oid)
+        if missing:
+            reply = self.request(
+                P.GET,
+                {"object_ids": [o.binary() for o in missing], "timeout": timeout},
+                timeout=None,
+            )
+            if reply.get("timeout"):
+                raise exceptions.GetTimeoutError(
+                    f"get() timed out after {timeout}s waiting for {len(missing)} objects"
+                )
+            errs = []
+            for oid_bytes, kind, payload in reply["values"]:
+                if kind == P.VAL_ERROR:
+                    errs.append(loads_inline(payload))
+                    out[oid_bytes] = ("__err__", errs[-1])
+                else:
+                    val = self.decode_value(oid_bytes, kind, payload)
+                    out[oid_bytes] = val
+                    with self._obj_cache_lock:
+                        if len(self._obj_cache) >= 4096:
+                            # crude half-eviction keeps the cache bounded
+                            for k in list(self._obj_cache)[:2048]:
+                                del self._obj_cache[k]
+                        self._obj_cache[oid_bytes] = val
+            if errs:
+                raise errs[0]
+        return [out[o.binary()] for o in object_ids]
+
+    def wait(
+        self,
+        object_ids: Sequence[ObjectID],
+        num_returns: int,
+        timeout: Optional[float],
+        fetch_local: bool = True,
+    ) -> Tuple[List[bytes], List[bytes]]:
+        reply = self.request(
+            P.WAIT,
+            {
+                "object_ids": [o.binary() for o in object_ids],
+                "num_returns": num_returns,
+                "timeout": timeout,
+            },
+        )
+        return reply["ready"], reply["not_ready"]
+
+    def free(self, object_ids: Sequence[ObjectID]) -> None:
+        with self._obj_cache_lock:
+            for o in object_ids:
+                self._obj_cache.pop(o.binary(), None)
+        self.send_async(P.FREE, {"object_ids": [o.binary() for o in object_ids]})
+
+    # ----------------------------------------------------------------- tasks
+    def register_function(self, fn_id: str, blob: bytes) -> None:
+        if fn_id not in self._seen_fns:
+            self._seen_fns[fn_id] = True
+            self.send_async(P.REGISTER_FUNCTION, {"fn_id": fn_id, "blob": blob})
+
+    def submit_task(
+        self,
+        fn_id: str,
+        args_kind: str,
+        args_payload: Any,
+        arg_dep_ids: List[bytes],
+        num_returns: int,
+        resources: Dict[str, float],
+        options: dict,
+    ) -> List[ObjectID]:
+        task_id = TaskID.generate()
+        return_ids = [ObjectID.generate() for _ in range(num_returns)]
+        self.send_async(
+            P.SUBMIT_TASK,
+            {
+                "task_id": task_id.binary(),
+                "fn_id": fn_id,
+                "args_kind": args_kind,
+                "args_payload": args_payload,
+                "arg_deps": arg_dep_ids,
+                "return_ids": [r.binary() for r in return_ids],
+                "resources": resources,
+                "options": options,
+            },
+        )
+        return return_ids
+
+    def create_actor(
+        self,
+        fn_id: str,
+        args_kind: str,
+        args_payload: Any,
+        arg_dep_ids: List[bytes],
+        resources: Dict[str, float],
+        options: dict,
+    ) -> Tuple[ActorID, ObjectID]:
+        actor_id = ActorID.generate()
+        ready_id = ObjectID.generate()
+        payload = {
+            "actor_id": actor_id.binary(),
+            "fn_id": fn_id,
+            "args_kind": args_kind,
+            "args_payload": args_payload,
+            "arg_deps": arg_dep_ids,
+            "ready_id": ready_id.binary(),
+            "resources": resources,
+            "options": options,
+        }
+        if options.get("name"):
+            # Named creation is synchronous so duplicate names raise here,
+            # matching the reference (actor.py _remote name check via GCS).
+            reply = self.request(P.CREATE_ACTOR, payload)
+            if reply.get("error"):
+                raise ValueError(reply["error"])
+        else:
+            self.send(P.CREATE_ACTOR, payload)
+        return actor_id, ready_id
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args_kind: str,
+        args_payload: Any,
+        arg_dep_ids: List[bytes],
+        num_returns: int,
+        options: dict,
+    ) -> List[ObjectID]:
+        task_id = TaskID.generate()
+        return_ids = [ObjectID.generate() for _ in range(num_returns)]
+        self.send_async(
+            P.SUBMIT_ACTOR_TASK,
+            {
+                "task_id": task_id.binary(),
+                "actor_id": actor_id.binary(),
+                "method": method_name,
+                "args_kind": args_kind,
+                "args_payload": args_payload,
+                "arg_deps": arg_dep_ids,
+                "return_ids": [r.binary() for r in return_ids],
+                "options": options,
+            },
+        )
+        return return_ids
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self.send(P.KILL_ACTOR, {"actor_id": actor_id.binary(), "no_restart": no_restart})
+
+    def cancel(self, object_id: ObjectID, force: bool = False) -> None:
+        self.send(P.CANCEL, {"object_id": object_id.binary(), "force": force})
+
+    # -------------------------------------------------------------- metadata
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True) -> bool:
+        return self.request(P.KV_PUT, {"key": key, "value": value, "overwrite": overwrite})["ok"]
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        return self.request(P.KV_GET, {"key": key})["value"]
+
+    def kv_del(self, key: bytes) -> bool:
+        return self.request(P.KV_DEL, {"key": key})["ok"]
+
+    def kv_keys(self, prefix: bytes) -> List[bytes]:
+        return self.request(P.KV_KEYS, {"prefix": prefix})["keys"]
+
+    def get_named_actor(self, name: str, namespace: Optional[str] = None):
+        reply = self.request(P.GET_ACTOR, {"name": name, "namespace": namespace})
+        return reply.get("actor_id")
+
+    def create_placement_group(self, bundles, strategy: str, name: str = "") -> bytes:
+        reply = self.request(P.CREATE_PG, {"bundles": bundles, "strategy": strategy, "name": name})
+        if reply.get("error"):
+            raise ValueError(reply["error"])
+        return reply["pg_id"]
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        self.send(P.REMOVE_PG, {"pg_id": pg_id})
+
+    def pg_ready(self, pg_id: bytes, timeout: Optional[float] = None) -> bool:
+        reply = self.request(P.PG_READY, {"pg_id": pg_id, "timeout": timeout})
+        return reply["ready"]
+
+    def list_state(self, kind: str) -> list:
+        return self.request(P.LIST_STATE, {"kind": kind})["items"]
+
+    def cluster_resources(self, available: bool = False) -> dict:
+        return self.request(P.CLUSTER_RESOURCES, {"available": available})["resources"]
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                self.conn.close()
+            except Exception:
+                pass

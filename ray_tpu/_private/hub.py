@@ -1,0 +1,1164 @@
+"""Control hub: node registry, object directory, scheduler, actor manager.
+
+This one component plays the roles the reference splits across three
+processes — the GCS server (reference: src/ray/gcs/gcs_server/
+gcs_server.h:90), the per-node raylet (src/ray/raylet/node_manager.h:122)
+and its ClusterTaskManager/LocalTaskManager (src/ray/raylet/scheduling/),
+and the plasma metadata plane. On a TPU host the control plane does not
+need to be distributed the way Ray's is (scheduling decisions are
+node-local; cross-host coordination happens through jax.distributed and
+the collective layer), so a single-threaded event-loop hub gives us the
+same semantics with none of the cross-process consistency machinery.
+
+Threading model: ONE router thread owns all state (no locks); it
+multiplexes every client connection plus a deadline heap for timeouts —
+the same single-reactor shape as the raylet's instrumented asio loop
+(reference: src/ray/common/asio/instrumented_io_context.h).
+
+Scheduling: resource-based admission (CPU/TPU/custom resources +
+placement-group bundle accounting) then dispatch to an idle worker from
+the pool, spawning new workers on demand up to a cap — mirroring the
+reference's lease-based WorkerPool flow (src/ray/raylet/worker_pool.h,
+local_task_manager.cc:124 DispatchScheduledTasksToWorkers) without the
+lease round-trip: the hub pushes tasks straight to workers.
+
+Fault tolerance: worker death is detected by connection EOF (the raylet
+uses SIGCHLD, reference: src/ray/raylet/worker_pool.cc); running tasks
+are retried per max_retries, actors restarted per max_restarts
+(reference: src/ray/gcs/gcs_server/gcs_actor_manager.h:96,569).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from multiprocessing.connection import Listener, wait as conn_wait
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import protocol as P
+from .ids import WorkerID
+from .serialization import dumps_inline, loads_inline
+
+# Chaos hook for fault-injection tests (reference: src/ray/rpc/rpc_chaos.h:23
+# — env-selected per-method message drop). Set RAY_TPU_CHAOS_DROP to
+# "msg_type:probability" to drop inbound messages of that type.
+def _parse_chaos():
+    spec = os.environ.get("RAY_TPU_CHAOS_DROP", "")
+    out = {}
+    for part in spec.split(","):
+        if ":" in part:
+            mt, prob = part.rsplit(":", 1)
+            try:
+                out[mt] = float(prob)
+            except ValueError:
+                pass
+    return out
+
+
+_CHAOS = _parse_chaos()
+
+
+@dataclass
+class ObjEntry:
+    ready: bool = False
+    kind: str = ""
+    payload: Any = None
+    size: int = 0
+    # (conn, req_id) waiters registered by pending GETs
+    task_waiters: List[bytes] = field(default_factory=list)  # task_ids blocked on this obj
+
+
+@dataclass
+class TaskSpec:
+    task_id: bytes
+    fn_id: str
+    args_kind: str
+    args_payload: Any
+    return_ids: List[bytes]
+    resources: Dict[str, float]
+    options: dict
+    deps_remaining: int = 0
+    retries_left: int = 0
+    is_actor_create: bool = False
+    actor_id: Optional[bytes] = None  # for actor tasks
+    method: Optional[str] = None
+    ready_id: Optional[bytes] = None  # actor creation ready object
+
+
+@dataclass
+class WorkerEntry:
+    worker_id: str
+    conn: Any = None
+    proc: Any = None
+    state: str = "starting"  # starting | idle | busy | actor | dead
+    current_task: Optional[TaskSpec] = None
+    actor_id: Optional[bytes] = None
+    seen_fns: Set[str] = field(default_factory=set)
+    tpu_chips: Tuple[int, ...] = ()  # chips assigned to the current task
+    # jax binds devices at first import, so once a worker has run a TPU task
+    # its chips are pinned for the worker's lifetime; the scheduler only
+    # reuses it for tasks wanting the same chip count (chip affinity).
+    pinned_chips: Optional[Tuple[int, ...]] = None
+
+
+@dataclass
+class ActorEntry:
+    actor_id: bytes
+    fn_id: str
+    args_kind: str
+    args_payload: Any
+    resources: Dict[str, float]
+    options: dict
+    ready_id: bytes
+    state: str = "pending"  # pending | alive | restarting | dead
+    worker_id: Optional[str] = None
+    name: str = ""
+    restarts_left: int = 0
+    pending_calls: deque = field(default_factory=deque)
+    inflight: Dict[bytes, TaskSpec] = field(default_factory=dict)  # task_id -> spec
+    pool: Optional[tuple] = None  # resource pool holding the actor's lifetime resources
+
+
+@dataclass
+class PGEntry:
+    pg_id: bytes
+    bundles: List[Dict[str, float]]
+    strategy: str
+    name: str = ""
+    ready: bool = True
+    # per-bundle available resources (bundle reservations are exclusive)
+    bundle_avail: List[Dict[str, float]] = field(default_factory=list)
+
+
+@dataclass
+class GetReq:
+    conn: Any
+    req_id: int
+    remaining: Set[bytes]
+    all_ids: List[bytes]
+    deadline: Optional[float] = None
+    done: bool = False
+
+
+@dataclass
+class WaitReq:
+    conn: Any
+    req_id: int
+    ids: List[bytes]
+    num_returns: int
+    deadline: Optional[float] = None
+    done: bool = False
+
+
+class Hub:
+    def __init__(
+        self,
+        session_dir: str,
+        resources: Dict[str, float],
+        max_workers: Optional[int] = None,
+        tpu_chip_ids: Optional[List[int]] = None,
+        worker_env: Optional[Dict[str, str]] = None,
+    ):
+        self.session_dir = session_dir
+        os.makedirs(session_dir, exist_ok=True)
+        self.addr = os.path.join(session_dir, "hub.sock")
+        self.listener = Listener(self.addr, family="AF_UNIX")
+        self.total_resources = dict(resources)
+        self.avail_resources = dict(resources)
+        self.max_workers = max_workers or max(4, int(resources.get("CPU", 4)))
+        self.tpu_chip_ids = list(tpu_chip_ids or [])
+        self.free_tpu_chips = set(self.tpu_chip_ids)
+        self.worker_env = dict(worker_env or {})
+
+        self.objects: Dict[bytes, ObjEntry] = {}
+        self.functions: Dict[str, bytes] = {}
+        self.tasks: Dict[bytes, TaskSpec] = {}  # pending+runnable normal tasks
+        # Runnable tasks are queued per scheduling class (resource shape ×
+        # placement pool), the reference's SchedulingKey idea (src/ray/
+        # core_worker/transport/normal_task_submitter.h:45-58): placement is
+        # tried only at each class's head, so a blocked class never costs a
+        # scan and heterogeneous classes never block each other.
+        self.runnable: Dict[tuple, deque] = {}
+        self.workers: Dict[str, WorkerEntry] = {}
+        self.conn_to_worker: Dict[Any, str] = {}
+        self.actors: Dict[bytes, ActorEntry] = {}
+        self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        self.pgs: Dict[bytes, PGEntry] = {}
+        self.kv: Dict[bytes, bytes] = {}
+        self.get_reqs: List[GetReq] = []
+        self.obj_get_waiters: Dict[bytes, List[GetReq]] = {}
+        self.obj_wait_waiters: Dict[bytes, List[WaitReq]] = {}
+        self.dep_waiters: Dict[bytes, List[TaskSpec]] = {}
+        self.timers: List[Tuple[float, int, Any]] = []  # (deadline, seq, callback)
+        self._timer_seq = itertools.count()
+        self.client_conns: List[Any] = []
+        self.driver_conn = None
+        self._running = True
+        self._spawning = 0
+        self._dispatching = False
+        self._dispatch_pending = False
+        self._pg_counter = itertools.count(1)
+        self._shutdown_evt = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True, name="ray-tpu-hub")
+
+    # ------------------------------------------------------------------ wire
+    def start(self):
+        self.thread.start()
+
+    def _send(self, conn, msg_type: str, payload: dict):
+        try:
+            conn.send_bytes(dumps_inline((msg_type, payload)))
+        except (OSError, BrokenPipeError, EOFError):
+            pass
+
+    def _reply(self, conn, req_id: int, **payload):
+        self._send(conn, P.REPLY, dict(payload, req_id=req_id))
+
+    def _run(self):
+        self._add_timer(1.0, self._reap_workers)
+        lsock = self.listener._listener._socket  # raw fd for readiness polling
+        while self._running:
+            now = time.monotonic()
+            while self.timers and self.timers[0][0] <= now:
+                _, _, cb = heapq.heappop(self.timers)
+                try:
+                    cb()
+                except Exception:
+                    import traceback
+
+                    sys.stderr.write(
+                        f"[ray_tpu] hub timer error:\n{traceback.format_exc()}\n"
+                    )
+            timeout = None
+            if self.timers:
+                timeout = max(0.0, self.timers[0][0] - time.monotonic())
+            readable = conn_wait([lsock] + self.client_conns, timeout=timeout)
+            for r in readable:
+                if r is lsock:
+                    conn = self.listener.accept()
+                    self.client_conns.append(conn)
+                    continue
+                try:
+                    while True:
+                        blob = r.recv_bytes()
+                        msg_type, payload = loads_inline(blob)
+                        try:
+                            self._handle(r, msg_type, payload)
+                        except Exception:
+                            # A handler bug must never kill the control plane.
+                            import traceback
+
+                            sys.stderr.write(
+                                f"[ray_tpu] hub handler error on {msg_type}:\n"
+                                f"{traceback.format_exc()}\n"
+                            )
+                        if not r.poll(0):
+                            break
+                except (EOFError, OSError):
+                    self._handle_disconnect(r)
+        # teardown
+        for w in self.workers.values():
+            self._kill_worker(w)
+        try:
+            self.listener.close()
+        except Exception:
+            pass
+        self._shutdown_evt.set()
+
+    def _add_timer(self, delay: float, cb):
+        heapq.heappush(self.timers, (time.monotonic() + delay, next(self._timer_seq), cb))
+
+    # -------------------------------------------------------------- dispatch
+    def _handle(self, conn, msg_type: str, payload):
+        if _CHAOS:
+            import random
+
+            prob = _CHAOS.get(msg_type)
+            if prob and random.random() < prob:
+                return  # injected message drop
+        if msg_type == "batch":
+            for mt, pl in payload:
+                h = getattr(self, f"_on_{mt}", None)
+                if h is not None:
+                    h(conn, pl)
+            return
+        handler = getattr(self, f"_on_{msg_type}", None)
+        if handler is None:
+            return
+        handler(conn, payload)
+
+    def _on_hello(self, conn, p):
+        if p["role"] == "worker":
+            wid = p["worker_id"]
+            w = self.workers.get(wid)
+            if w is None:
+                w = WorkerEntry(worker_id=wid)
+                self.workers[wid] = w
+            w.conn = conn
+            w.state = "idle"
+            self.conn_to_worker[conn] = wid
+            self._spawning = max(0, self._spawning - 1)
+            self._dispatch()
+        else:
+            self.driver_conn = conn
+
+    # ----- objects
+    def _on_put(self, conn, p):
+        self._object_ready(p["object_id"], p["kind"], p["payload"], p.get("size", 0))
+
+    def _object_ready(self, oid: bytes, kind: str, payload: Any, size: int):
+        e = self.objects.get(oid)
+        if e is None:
+            e = self.objects[oid] = ObjEntry()
+        if e.ready:
+            return
+        e.ready, e.kind, e.payload, e.size = True, kind, payload, size
+        # unblock task dependencies
+        for spec in self.dep_waiters.pop(oid, []):
+            spec.deps_remaining -= 1
+            if spec.deps_remaining == 0:
+                if spec.method is not None:
+                    actor = self.actors.get(spec.actor_id)
+                    if actor is None or actor.state == "dead":
+                        from ..exceptions import ActorDiedError
+
+                        blob = dumps_inline(ActorDiedError(msg="Actor is dead."))
+                        for roid in spec.return_ids:
+                            self._object_ready(roid, P.VAL_ERROR, blob, 0)
+                    else:
+                        self._route_actor_call(actor, spec)
+                else:
+                    self._enqueue_runnable(spec)
+        # fulfill GET waiters
+        for req in self.obj_get_waiters.pop(oid, []):
+            if req.done:
+                continue
+            req.remaining.discard(oid)
+            if not req.remaining:
+                self._fulfill_get(req)
+        # fulfill WAIT waiters
+        for req in self.obj_wait_waiters.pop(oid, []):
+            if req.done:
+                continue
+            self._check_wait(req)
+        self._dispatch()
+
+    def _fulfill_get(self, req: GetReq):
+        req.done = True
+        values = []
+        for oid in req.all_ids:
+            e = self.objects[oid]
+            values.append((oid, e.kind, e.payload))
+        self._reply(req.conn, req.req_id, values=values)
+
+    def _on_get(self, conn, p):
+        ids = p["object_ids"]
+        missing = {oid for oid in ids if not self.objects.get(oid, ObjEntry()).ready}
+        req = GetReq(conn=conn, req_id=p["req_id"], remaining=missing, all_ids=ids)
+        if not missing:
+            self._fulfill_get(req)
+            return
+        for oid in missing:
+            if oid not in self.objects:
+                self.objects[oid] = ObjEntry()
+            self.obj_get_waiters.setdefault(oid, []).append(req)
+        timeout = p.get("timeout")
+        if timeout is not None:
+            def expire(req=req):
+                if not req.done:
+                    req.done = True
+                    self._reply(req.conn, req.req_id, timeout=True)
+            self._add_timer(timeout, expire)
+
+    def _check_wait(self, req: WaitReq):
+        ready = [oid for oid in req.ids if self.objects.get(oid) and self.objects[oid].ready]
+        if len(ready) >= req.num_returns:
+            req.done = True
+            ready = ready[: req.num_returns]
+            rset = set(ready)
+            self._reply(
+                req.conn,
+                req.req_id,
+                ready=ready,
+                not_ready=[o for o in req.ids if o not in rset],
+            )
+            return True
+        return False
+
+    def _on_wait(self, conn, p):
+        req = WaitReq(
+            conn=conn,
+            req_id=p["req_id"],
+            ids=p["object_ids"],
+            num_returns=min(p["num_returns"], len(p["object_ids"])),
+        )
+        if self._check_wait(req):
+            return
+        for oid in req.ids:
+            if oid not in self.objects:
+                self.objects[oid] = ObjEntry()
+            if not self.objects[oid].ready:
+                self.obj_wait_waiters.setdefault(oid, []).append(req)
+        timeout = p.get("timeout")
+        if timeout is not None:
+            def expire(req=req):
+                if not req.done:
+                    req.done = True
+                    ready = [o for o in req.ids if self.objects.get(o) and self.objects[o].ready]
+                    rset = set(ready)
+                    self._reply(
+                        req.conn, req.req_id,
+                        ready=ready, not_ready=[o for o in req.ids if o not in rset],
+                    )
+            self._add_timer(timeout, expire)
+
+    def _on_free(self, conn, p):
+        for oid in p["object_ids"]:
+            e = self.objects.pop(oid, None)
+            if e and e.kind == P.VAL_SHM:
+                path = os.path.join(self.session_dir, "objects", e.payload)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+    # ----- functions
+    def _on_register_function(self, conn, p):
+        self.functions[p["fn_id"]] = p["blob"]
+
+    def _on_get_function(self, conn, p):
+        self._reply(conn, p["req_id"], blob=self.functions.get(p["fn_id"]))
+
+    # ----- kv
+    def _on_kv_put(self, conn, p):
+        if not p.get("overwrite", True) and p["key"] in self.kv:
+            self._reply(conn, p["req_id"], ok=False)
+            return
+        self.kv[p["key"]] = p["value"]
+        self._reply(conn, p["req_id"], ok=True)
+
+    def _on_kv_get(self, conn, p):
+        self._reply(conn, p["req_id"], value=self.kv.get(p["key"]))
+
+    def _on_kv_del(self, conn, p):
+        ok = self.kv.pop(p["key"], None) is not None
+        self._reply(conn, p["req_id"], ok=ok)
+
+    def _on_kv_keys(self, conn, p):
+        prefix = p["prefix"]
+        self._reply(conn, p["req_id"], keys=[k for k in self.kv if k.startswith(prefix)])
+
+    # ----- tasks
+    def _on_submit_task(self, conn, p):
+        spec = TaskSpec(
+            task_id=p["task_id"],
+            fn_id=p["fn_id"],
+            args_kind=p["args_kind"],
+            args_payload=p["args_payload"],
+            return_ids=p["return_ids"],
+            resources=p["resources"],
+            options=p["options"],
+            retries_left=p["options"].get("max_retries", 3),
+        )
+        self._admit(spec, p.get("arg_deps", []))
+
+    def _admit(self, spec: TaskSpec, deps: List[bytes]):
+        pending = 0
+        for dep in deps:
+            e = self.objects.get(dep)
+            if e is None:
+                e = self.objects[dep] = ObjEntry()
+            if not e.ready:
+                pending += 1
+                self.dep_waiters.setdefault(dep, []).append(spec)
+        spec.deps_remaining = pending
+        self.tasks[spec.task_id] = spec
+        if pending == 0:
+            self._enqueue_runnable(spec)
+
+    def _sched_class(self, spec: TaskSpec) -> tuple:
+        pg = spec.options.get("placement_group")
+        res_key = tuple(sorted(spec.resources.items()))
+        return (res_key, pg[0] if pg else None, pg[1] if pg else None)
+
+    def _enqueue_runnable(self, spec: TaskSpec):
+        key = self._sched_class(spec)
+        q = self.runnable.get(key)
+        if q is None:
+            q = self.runnable[key] = deque()
+        q.append(spec)
+        self._dispatch()
+
+    def _resources_fit(self, need: Dict[str, float], avail: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+    def _acquire(self, need: Dict[str, float], avail: Dict[str, float]):
+        for k, v in need.items():
+            avail[k] = avail.get(k, 0.0) - v
+
+    def _release(self, need: Dict[str, float], avail: Dict[str, float]):
+        for k, v in need.items():
+            avail[k] = avail.get(k, 0.0) + v
+
+    def _effective_pools(self, spec: TaskSpec):
+        """Resource pools this task draws from: node-wide, or a PG bundle."""
+        pg = spec.options.get("placement_group")
+        if pg:
+            pg_id, bundle_idx = pg
+            entry = self.pgs.get(pg_id)
+            if entry is None:
+                return None  # PG removed; fail the task
+            if not entry.ready:
+                self._try_reserve_pg(entry)
+                if not entry.ready:
+                    return []  # PG not reserved yet: task must queue
+            if bundle_idx is not None and bundle_idx >= len(entry.bundles):
+                return None  # invalid bundle index; fail the task
+            if bundle_idx is None or bundle_idx < 0:
+                # any bundle with room
+                for i, avail in enumerate(entry.bundle_avail):
+                    if self._resources_fit(spec.resources, avail):
+                        return [("pg", entry, i)]
+                return []
+            return [("pg", entry, bundle_idx)]
+        return [("node", None, None)]
+
+    def _dispatch(self):
+        # Non-reentrant: placement can fail tasks, which marks objects ready,
+        # which can trigger nested _dispatch calls — those just set a flag and
+        # the outer frame loops again over consistent state.
+        if self._dispatching:
+            self._dispatch_pending = True
+            return
+        self._dispatching = True
+        try:
+            while True:
+                self._dispatch_pending = False
+                self._dispatch_once()
+                if not self._dispatch_pending:
+                    break
+        finally:
+            self._dispatching = False
+
+    def _dispatch_once(self):
+        # Head-only placement per scheduling class: O(#classes) per event.
+        total_pending = 0
+        empty_keys = []
+        for key, q in list(self.runnable.items()):
+            while q:
+                placed = self._try_place(q[0])
+                if placed in ("placed", "failed"):
+                    q.popleft()
+                else:
+                    break
+            if not q:
+                empty_keys.append(key)
+            total_pending += len(q)
+        for key in empty_keys:
+            if not self.runnable.get(key):
+                self.runnable.pop(key, None)
+        # spawn workers if runnable work exceeds idle capacity
+        if total_pending:
+            idle = sum(1 for w in self.workers.values() if w.state == "idle")
+            want = total_pending - idle - self._spawning
+            can = self.max_workers - len(self.workers) - self._spawning
+            for _ in range(max(0, min(want, can))):
+                self._spawn_worker()
+
+    def _try_place(self, spec: TaskSpec) -> str:
+        pools = self._effective_pools(spec)
+        if pools is None:
+            self._fail_task(spec, ValueError("placement group was removed"))
+            return "failed"
+        if not pools:
+            return "defer"
+        kind, entry, bidx = pools[0]
+        avail = self.avail_resources if kind == "node" else entry.bundle_avail[bidx]
+        if not self._resources_fit(spec.resources, avail):
+            return "defer"
+        n_chips = int(spec.resources.get("TPU", 0))
+        worker, chips = self._find_idle_worker(spec, n_chips)
+        if worker is None:
+            return "defer"
+        # allocate
+        self._acquire(spec.resources, avail)
+        spec.options["_pool"] = (kind, entry.pg_id if entry else None, bidx)
+        if chips and worker.pinned_chips is None:
+            # pin: the chips leave the free pool for this worker's lifetime
+            self.free_tpu_chips.difference_update(chips)
+            worker.pinned_chips = chips
+        self._send_exec(worker, spec, chips)
+        return "placed"
+
+    def _find_idle_worker(self, spec: TaskSpec, n_chips: int):
+        """Pick an idle worker; TPU tasks require chip affinity (a worker
+        pinned to exactly n chips, or a fresh worker + n free chips)."""
+        if n_chips > 0:
+            fresh = None
+            for w in self.workers.values():
+                if w.state != "idle":
+                    continue
+                if w.pinned_chips is not None and len(w.pinned_chips) == n_chips:
+                    return w, w.pinned_chips
+                if w.pinned_chips is None and fresh is None:
+                    fresh = w
+            if fresh is not None and len(self.free_tpu_chips) >= n_chips:
+                return fresh, tuple(sorted(self.free_tpu_chips))[:n_chips]
+            return None, ()
+        best = None
+        for w in self.workers.values():
+            if w.state != "idle":
+                continue
+            # prefer non-TPU-pinned workers for CPU tasks, and fn cache hits
+            if spec.fn_id in w.seen_fns and w.pinned_chips is None:
+                return w, ()
+            if best is None or (best.pinned_chips is not None and w.pinned_chips is None):
+                best = w
+        return best, ()
+
+    def _send_exec(self, worker: WorkerEntry, spec: TaskSpec, chips: Tuple[int, ...]):
+        worker.state = "busy"
+        worker.current_task = spec
+        worker.tpu_chips = chips
+        fn_blob = None
+        if spec.fn_id not in worker.seen_fns:
+            fn_blob = self.functions.get(spec.fn_id)
+            worker.seen_fns.add(spec.fn_id)
+        msg = P.EXEC_ACTOR_CREATE if spec.is_actor_create else P.EXEC_TASK
+        self._send(
+            worker.conn,
+            msg,
+            {
+                "task_id": spec.task_id,
+                "fn_id": spec.fn_id,
+                "fn_blob": fn_blob,
+                "args_kind": spec.args_kind,
+                "args_payload": spec.args_payload,
+                "return_ids": spec.return_ids,
+                "tpu_chips": chips,
+                "actor_id": spec.actor_id,
+                "ready_id": spec.ready_id,
+                "options": {k: v for k, v in spec.options.items() if k in ("max_concurrency",)},
+            },
+        )
+
+    def _spawn_worker(self):
+        wid = WorkerID.generate().hex()
+        self._spawning += 1
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RAY_TPU_HUB_ADDR"] = self.addr
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_WORKER_ID"] = wid
+        # Propagate the driver's import paths so workers can import ray_tpu
+        # and user modules regardless of cwd (the reference ships PYTHONPATH
+        # to workers through the runtime env / worker command line).
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        paths = [pkg_parent] + [p for p in sys.path if p]
+        if env.get("PYTHONPATH"):
+            paths.append(env["PYTHONPATH"])
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_process"],
+            env=env,
+            cwd=os.getcwd(),
+        )
+        self.workers[wid] = WorkerEntry(worker_id=wid, proc=proc, state="starting")
+
+    def _reap_workers(self):
+        """Detect spawned workers that died before connecting (e.g. import
+        failure) so the scheduler doesn't wait on them forever."""
+        dead = [
+            w
+            for w in self.workers.values()
+            if w.proc is not None and w.proc.poll() is not None and w.conn is None
+        ]
+        for w in dead:
+            sys.stderr.write(
+                f"[ray_tpu] worker {w.worker_id} exited with code {w.proc.returncode} "
+                f"before connecting\n"
+            )
+            self._spawning = max(0, self._spawning - 1)
+            self.workers.pop(w.worker_id, None)
+        if dead:
+            self._dispatch()
+        self._add_timer(1.0, self._reap_workers)
+
+    def _on_task_done(self, conn, p):
+        wid = self.conn_to_worker.get(conn)
+        worker = self.workers.get(wid) if wid else None
+        spec = self.tasks.pop(p["task_id"], None)
+        if worker is not None and worker.state == "busy":
+            worker.state = "idle"
+            worker.current_task = None
+            worker.tpu_chips = ()  # chips stay pinned to the worker (affinity)
+        if spec is not None:
+            self._release_task_resources(spec)
+            if spec.actor_id is not None:
+                actor = self.actors.get(spec.actor_id)
+                if actor is not None:
+                    actor.inflight.pop(p["task_id"], None)
+        elif worker is not None and worker.actor_id:
+            actor = self.actors.get(worker.actor_id)
+            if actor is not None:
+                actor.inflight.pop(p["task_id"], None)
+        for oid, kind, payload, size in p["returns"]:
+            self._object_ready(oid, kind, payload, size)
+        self._dispatch()
+
+    def _release_task_resources(self, spec: TaskSpec):
+        pool = spec.options.pop("_pool", None)
+        if pool is None:
+            return
+        kind, pg_id, bidx = pool
+        if kind == "node":
+            self._release(spec.resources, self.avail_resources)
+        else:
+            entry = self.pgs.get(pg_id)
+            if entry is not None:
+                self._release(spec.resources, entry.bundle_avail[bidx])
+
+    def _fail_task(self, spec: TaskSpec, err: Exception):
+        from .serialization import dumps_inline as d
+
+        blob = d(err)
+        for oid in spec.return_ids:
+            self._object_ready(oid, P.VAL_ERROR, blob, 0)
+        if spec.ready_id:
+            self._object_ready(spec.ready_id, P.VAL_ERROR, blob, 0)
+        self.tasks.pop(spec.task_id, None)
+
+    # ----- actors
+    def _on_create_actor(self, conn, p):
+        options = p["options"]
+        entry = ActorEntry(
+            actor_id=p["actor_id"],
+            fn_id=p["fn_id"],
+            args_kind=p["args_kind"],
+            args_payload=p["args_payload"],
+            resources=p["resources"],
+            options=options,
+            ready_id=p["ready_id"],
+            name=options.get("name") or "",
+            restarts_left=options.get("max_restarts", 0),
+        )
+        name = options.get("name")
+        if name:
+            key = (options.get("namespace") or "default", name)
+            if key in self.named_actors and self.actors.get(self.named_actors[key], None) and self.actors[self.named_actors[key]].state != "dead":
+                self._reply(conn, p["req_id"], error=f"Actor with name '{name}' already exists")
+                return
+            self.named_actors[key] = entry.actor_id
+            self._reply(conn, p["req_id"], error=None)
+        self.actors[entry.actor_id] = entry
+        spec = TaskSpec(
+            task_id=p["actor_id"],  # creation task id == actor id
+            fn_id=p["fn_id"],
+            args_kind=p["args_kind"],
+            args_payload=p["args_payload"],
+            return_ids=[],
+            resources=p["resources"],
+            options=dict(options),
+            is_actor_create=True,
+            actor_id=p["actor_id"],
+            ready_id=p["ready_id"],
+        )
+        self._admit(spec, p.get("arg_deps", []))
+
+    def _on_actor_ready(self, conn, p):
+        wid = self.conn_to_worker.get(conn)
+        worker = self.workers.get(wid)
+        actor = self.actors.get(p["actor_id"])
+        spec = self.tasks.pop(p["actor_id"], None)
+        if actor is None or worker is None:
+            return
+        if p.get("error") is not None:
+            # constructor raised: actor is dead on arrival
+            actor.state = "dead"
+            if spec is not None:
+                self._release_task_resources(spec)
+            worker.state = "idle"
+            worker.actor_id = None
+            worker.tpu_chips = ()  # chips remain pinned to the worker
+            self._object_ready(actor.ready_id, P.VAL_ERROR, p["error"], 0)
+            self._drain_actor_queue_with_error(actor)
+            self._dispatch()
+            return
+        actor.state = "alive"
+        actor.worker_id = wid
+        worker.state = "actor"
+        worker.actor_id = actor.actor_id
+        worker.current_task = None
+        # Actor creation resources stay held for the actor's lifetime.
+        actor.pool = spec.options.get("_pool") if spec is not None else None
+        self._object_ready(actor.ready_id, P.VAL_INLINE, dumps_inline((b"P\x80\x05N.", [])), 0)
+        while actor.pending_calls:
+            call = actor.pending_calls.popleft()
+            self._forward_actor_call(actor, call)
+        self._dispatch()
+
+    def _on_submit_actor_task(self, conn, p):
+        actor = self.actors.get(p["actor_id"])
+        spec = TaskSpec(
+            task_id=p["task_id"],
+            fn_id="",
+            args_kind=p["args_kind"],
+            args_payload=p["args_payload"],
+            return_ids=p["return_ids"],
+            resources={},
+            options=p["options"],
+            actor_id=p["actor_id"],
+            method=p["method"],
+        )
+        if actor is None or actor.state == "dead":
+            from ..exceptions import ActorDiedError
+
+            blob = dumps_inline(ActorDiedError(msg="Actor is dead."))
+            for oid in spec.return_ids:
+                self._object_ready(oid, P.VAL_ERROR, blob, 0)
+            return
+        deps = p.get("arg_deps", [])
+        pending = 0
+        for dep in deps:
+            e = self.objects.get(dep)
+            if e is None:
+                e = self.objects[dep] = ObjEntry()
+            if not e.ready:
+                pending += 1
+                self.dep_waiters.setdefault(dep, []).append(spec)
+        spec.deps_remaining = pending
+        spec.options["_actor_call"] = True
+        if pending:
+            self.tasks[spec.task_id] = spec
+            return
+        self._route_actor_call(actor, spec)
+
+    def _route_actor_call(self, actor: ActorEntry, spec: TaskSpec):
+        if actor.state == "alive":
+            self._forward_actor_call(actor, spec)
+        else:
+            actor.pending_calls.append(spec)
+
+    def _forward_actor_call(self, actor: ActorEntry, spec: TaskSpec):
+        worker = self.workers.get(actor.worker_id)
+        if worker is None or worker.conn is None:
+            actor.pending_calls.append(spec)
+            return
+        actor.inflight[spec.task_id] = spec
+        self._send(
+            worker.conn,
+            P.EXEC_ACTOR_TASK,
+            {
+                "task_id": spec.task_id,
+                "actor_id": actor.actor_id,
+                "method": spec.method,
+                "args_kind": spec.args_kind,
+                "args_payload": spec.args_payload,
+                "return_ids": spec.return_ids,
+            },
+        )
+
+    def _drain_actor_queue_with_error(self, actor: ActorEntry):
+        from ..exceptions import ActorDiedError
+
+        blob = dumps_inline(ActorDiedError(msg="The actor died before this call could run."))
+        while actor.pending_calls:
+            spec = actor.pending_calls.popleft()
+            for oid in spec.return_ids:
+                self._object_ready(oid, P.VAL_ERROR, blob, 0)
+        for spec in actor.inflight.values():
+            for oid in spec.return_ids:
+                self._object_ready(oid, P.VAL_ERROR, blob, 0)
+        actor.inflight.clear()
+
+    def _on_kill_actor(self, conn, p):
+        actor = self.actors.get(p["actor_id"])
+        if actor is None:
+            return
+        if p.get("no_restart", True):
+            actor.restarts_left = 0
+        worker = self.workers.get(actor.worker_id) if actor.worker_id else None
+        if worker is not None:
+            self._kill_worker(worker)
+            self._worker_died(worker)
+        elif p.get("no_restart", True):
+            from ..exceptions import ActorDiedError
+
+            # Constructor may already be running on a worker that hasn't
+            # reported ACTOR_READY yet — kill that worker.
+            for w in list(self.workers.values()):
+                if w.current_task is not None and w.current_task.actor_id == actor.actor_id:
+                    self._kill_worker(w)
+                    self._worker_died(w)
+                    return
+            # Otherwise the creation is still queued: cancel it outright.
+            spec = self.tasks.pop(actor.actor_id, None)
+            if spec is not None:
+                key = self._sched_class(spec)
+                q = self.runnable.get(key)
+                if q is not None and spec in q:
+                    q.remove(spec)
+            actor.state = "dead"
+            blob = dumps_inline(ActorDiedError(msg="The actor was killed before it started."))
+            self._object_ready(actor.ready_id, P.VAL_ERROR, blob, 0)
+            self._drain_actor_queue_with_error(actor)
+            self._dispatch()
+
+    def _kill_worker(self, w: WorkerEntry):
+        if w.conn is not None:
+            self._send(w.conn, P.KILL, {})
+        if w.proc is not None:
+            try:
+                w.proc.terminate()
+            except Exception:
+                pass
+
+    # ----- worker failure handling
+    def _handle_disconnect(self, conn):
+        if conn in self.client_conns:
+            self.client_conns.remove(conn)
+        wid = self.conn_to_worker.pop(conn, None)
+        if wid is None:
+            if conn is self.driver_conn:
+                # driver died: shut the whole session down
+                self._running = False
+            return
+        worker = self.workers.pop(wid, None)
+        if worker is None:
+            return
+        self._worker_died(worker)
+
+    def _worker_died(self, worker: WorkerEntry):
+        from ..exceptions import ActorDiedError, WorkerCrashedError
+
+        worker.state = "dead"
+        self.workers.pop(worker.worker_id, None)
+        if worker.conn in self.client_conns:
+            self.client_conns.remove(worker.conn)
+        self.conn_to_worker.pop(worker.conn, None)
+        if worker.pinned_chips:
+            self.free_tpu_chips.update(worker.pinned_chips)
+        spec = worker.current_task
+        if spec is not None and spec.is_actor_create:
+            # actor died mid-constructor: release the creation resources
+            self._release_task_resources(spec)
+        if spec is not None and not spec.is_actor_create:
+            self._release_task_resources(spec)
+            if spec.retries_left > 0:
+                spec.retries_left -= 1
+                self._enqueue_runnable(spec)
+            else:
+                self._fail_task(spec, WorkerCrashedError("worker died while executing task"))
+        if worker.actor_id or (spec is not None and spec.is_actor_create):
+            actor_id = worker.actor_id or spec.actor_id
+            actor = self.actors.get(actor_id)
+            if actor is not None:
+                # release actor lifetime resources to the pool they came from
+                if actor.state == "alive":
+                    if actor.pool is not None and actor.pool[0] == "pg":
+                        entry = self.pgs.get(actor.pool[1])
+                        if entry is not None:
+                            self._release(actor.resources, entry.bundle_avail[actor.pool[2]])
+                    else:
+                        self._release(actor.resources, self.avail_resources)
+                    actor.pool = None
+                if actor.restarts_left != 0:
+                    if actor.restarts_left > 0:
+                        actor.restarts_left -= 1
+                    actor.state = "restarting"
+                    actor.worker_id = None
+                    # in-flight calls fail; queued calls run on the new incarnation
+                    blob = dumps_inline(ActorDiedError(msg="Actor died; call was in flight."))
+                    for s in actor.inflight.values():
+                        for oid in s.return_ids:
+                            self._object_ready(oid, P.VAL_ERROR, blob, 0)
+                    actor.inflight.clear()
+                    respawn = TaskSpec(
+                        task_id=actor.actor_id,
+                        fn_id=actor.fn_id,
+                        args_kind=actor.args_kind,
+                        args_payload=actor.args_payload,
+                        return_ids=[],
+                        resources=actor.resources,
+                        options=dict(actor.options),
+                        is_actor_create=True,
+                        actor_id=actor.actor_id,
+                        ready_id=actor.ready_id,
+                    )
+                    self.tasks[respawn.task_id] = respawn
+                    self._enqueue_runnable(respawn)
+                else:
+                    actor.state = "dead"
+                    self._drain_actor_queue_with_error(actor)
+        self._dispatch()
+
+    def _on_cancel(self, conn, p):
+        # best-effort: remove from runnable / pending
+        oid = p["object_id"]
+        from ..exceptions import TaskCancelledError
+
+        for q in self.runnable.values():
+            for spec in q:
+                if oid in spec.return_ids:
+                    q.remove(spec)
+                    self.tasks.pop(spec.task_id, None)
+                    self._fail_task(spec, TaskCancelledError("task was cancelled"))
+                    return
+
+    # ----- placement groups
+    def _on_create_pg(self, conn, p):
+        from .ids import PlacementGroupID
+
+        bundles = p["bundles"]
+        strategy = p["strategy"]
+        # validate: single node must fit all bundles for STRICT_PACK/PACK
+        total_need: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                total_need[k] = total_need.get(k, 0.0) + v
+        if strategy in ("STRICT_SPREAD",) and len(bundles) > 1:
+            self._reply(conn, p["req_id"], error="STRICT_SPREAD requires multiple nodes", pg_id=None)
+            return
+        if not self._resources_fit(total_need, self.avail_resources):
+            # Infeasible now; in the reference this would stay pending until
+            # resources appear (gcs_placement_group_scheduler 2PC). We queue it.
+            pass
+        pg_id = PlacementGroupID.generate().binary()
+        entry = PGEntry(
+            pg_id=pg_id,
+            bundles=bundles,
+            strategy=strategy,
+            name=p.get("name", ""),
+            ready=False,
+            bundle_avail=[dict(b) for b in bundles],
+        )
+        self.pgs[pg_id] = entry
+        self._try_reserve_pg(entry)
+        self._reply(conn, p["req_id"], pg_id=pg_id)
+
+    def _try_reserve_pg(self, entry: PGEntry):
+        if entry.ready:
+            return
+        total_need: Dict[str, float] = {}
+        for b in entry.bundles:
+            for k, v in b.items():
+                total_need[k] = total_need.get(k, 0.0) + v
+        if self._resources_fit(total_need, self.avail_resources):
+            self._acquire(total_need, self.avail_resources)
+            entry.ready = True
+            # notify PG_READY waiters via timers list (handled by _on_pg_ready polling)
+
+    def _on_remove_pg(self, conn, p):
+        entry = self.pgs.pop(p["pg_id"], None)
+        if entry is not None and entry.ready:
+            total: Dict[str, float] = {}
+            for b in entry.bundles:
+                for k, v in b.items():
+                    total[k] = total.get(k, 0.0) + v
+            self._release(total, self.avail_resources)
+        self._dispatch()
+
+    def _on_pg_ready(self, conn, p):
+        entry = self.pgs.get(p["pg_id"])
+        if entry is None:
+            self._reply(conn, p["req_id"], ready=False)
+            return
+        self._try_reserve_pg(entry)
+        if entry.ready:
+            self._reply(conn, p["req_id"], ready=True)
+            return
+        deadline = time.monotonic() + (p.get("timeout") or 3600.0)
+        req_id = p["req_id"]
+
+        def poll(entry=entry, conn=conn, req_id=req_id, deadline=deadline):
+            self._try_reserve_pg(entry)
+            if entry.ready:
+                self._reply(conn, req_id, ready=True)
+            elif time.monotonic() > deadline:
+                self._reply(conn, req_id, ready=False)
+            else:
+                self._add_timer(0.05, poll)
+
+        self._add_timer(0.05, poll)
+
+    # ----- introspection
+    def _on_get_actor(self, conn, p):
+        key = (p.get("namespace") or "default", p["name"])
+        aid = self.named_actors.get(key)
+        if aid is not None and self.actors.get(aid) and self.actors[aid].state == "dead":
+            aid = None
+        self._reply(conn, p["req_id"], actor_id=aid)
+
+    def _on_cluster_resources(self, conn, p):
+        res = self.avail_resources if p.get("available") else self.total_resources
+        self._reply(conn, p["req_id"], resources=dict(res))
+
+    def _on_list_state(self, conn, p):
+        kind = p["kind"]
+        items: List[dict] = []
+        if kind == "actors":
+            for a in self.actors.values():
+                items.append(
+                    {
+                        "actor_id": a.actor_id.hex(),
+                        "state": a.state.upper(),
+                        "name": a.name,
+                        "resources": a.resources,
+                    }
+                )
+        elif kind == "workers":
+            for w in self.workers.values():
+                items.append({"worker_id": w.worker_id, "state": w.state, "pid": w.proc.pid if w.proc else None})
+        elif kind == "tasks":
+            for t in self.tasks.values():
+                items.append({"task_id": t.task_id.hex(), "fn_id": t.fn_id})
+        elif kind == "placement_groups":
+            for g in self.pgs.values():
+                items.append(
+                    {"pg_id": g.pg_id.hex(), "strategy": g.strategy, "ready": g.ready, "bundles": g.bundles}
+                )
+        elif kind == "objects":
+            for oid, e in self.objects.items():
+                items.append({"object_id": oid.hex(), "ready": e.ready, "size": e.size, "kind": e.kind})
+        elif kind == "nodes":
+            items.append(
+                {
+                    "node_id": "local",
+                    "alive": True,
+                    "resources": dict(self.total_resources),
+                    "available": dict(self.avail_resources),
+                }
+            )
+        self._reply(conn, p["req_id"], items=items)
+
+    def _on_shutdown(self, conn, p):
+        self._running = False
+
+    def shutdown(self, timeout: float = 5.0):
+        self._running = False
+        # wake router via a self-connection
+        try:
+            from multiprocessing.connection import Client as MpClient
+
+            c = MpClient(self.addr, family="AF_UNIX")
+            c.close()
+        except Exception:
+            pass
+        self._shutdown_evt.wait(timeout)
+        for w in self.workers.values():
+            if w.proc is not None:
+                try:
+                    w.proc.terminate()
+                    w.proc.wait(timeout=1)
+                except Exception:
+                    try:
+                        w.proc.kill()
+                    except Exception:
+                        pass

@@ -1,0 +1,79 @@
+"""Unique identifiers for tasks, objects, and actors.
+
+Design follows the reference's nested-ID scheme (reference:
+src/ray/common/id.h and src/ray/design_docs/id_specification.md — JobID
+4B ⊂ ActorID 16B ⊂ TaskID 24B ⊂ ObjectID 28B) but simplified: IDs here
+are flat random byte strings. The nesting in the reference exists to
+support distributed lineage reconstruction by-prefix; our control
+service is authoritative for metadata, so flat IDs suffice and are
+cheaper to generate and hash.
+"""
+
+from __future__ import annotations
+
+import os
+import binascii
+
+_ID_LEN = 14  # bytes; 112 bits of randomness — collision-free in practice
+
+
+class BaseID:
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, id_bytes: bytes):
+        self._bytes = id_bytes
+        self._hash = hash(id_bytes)
+
+    @classmethod
+    def generate(cls):
+        return cls(os.urandom(_ID_LEN))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(binascii.unhexlify(hex_str))
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class JobID(BaseID):
+    pass

@@ -1,0 +1,167 @@
+"""Node-local shared-memory object store.
+
+TPU-native re-think of the reference's plasma store (reference:
+src/ray/object_manager/plasma/ — dlmalloc arena over mmap/shm, fd passing
+via fling.cc, flatbuffer protocol). We get the same zero-copy property
+with far less machinery by backing each large object with an mmap'ed
+file under /dev/shm/<session>/ that every process on the node can map.
+There is no socket protocol: object *placement* metadata lives in the
+control hub; the bytes themselves are mapped directly.
+
+Small objects (< INLINE_THRESHOLD, like the reference's
+max_direct_call_object_size=100KB, reference: src/ray/common/
+ray_config_def.h) never touch shm — they travel inline through the hub,
+mirroring the reference's in-process CoreWorkerMemoryStore
+(src/ray/core_worker/store_provider/memory_store/memory_store.h:45).
+
+Wire layout of a segment:
+    [8B u64 header_len][header bytes]
+    per out-of-band buffer: [8B u64 buf_len][pad to 64B][buf bytes][pad]
+Buffers are 64-byte aligned so numpy views are alignment-friendly.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+from . import serialization
+
+INLINE_THRESHOLD = 100 * 1024  # match reference max_direct_call_object_size
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+class MappedSegment:
+    """An open mmap of one object segment; kept alive while views exist."""
+
+    __slots__ = ("path", "mm", "size")
+
+    def __init__(self, path: str, size: Optional[int] = None, create: bool = False):
+        self.path = path
+        if create:
+            # A retried task may rewrite the same object id; the old segment
+            # (if any) stays valid for existing mmaps after the unlink.
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self.mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            self.size = size
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                st = os.fstat(fd)
+                self.mm = mmap.mmap(fd, st.st_size)
+            finally:
+                os.close(fd)
+            self.size = st.st_size
+
+
+class ShmObjectStore:
+    """Per-process facade over the node's /dev/shm session directory."""
+
+    def __init__(self, session_dir: str):
+        self.dir = os.path.join(session_dir, "objects")
+        os.makedirs(self.dir, exist_ok=True)
+        self._segments: dict[str, MappedSegment] = {}
+        self._lock = threading.Lock()
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    def put(self, name: str, obj: Any) -> int:
+        """Serialize obj into a new segment. Returns segment size."""
+        header, buffers = serialization.dumps_oob(obj)
+        raws = [b.raw() for b in buffers]
+        size = _align(8 + len(header))
+        for r in raws:
+            size += _align(8) + _align(r.nbytes)
+        seg = MappedSegment(self._path(name), size=size, create=True)
+        mm = seg.mm
+        off = 0
+        mm[off : off + 8] = struct.pack("<Q", len(header))
+        mm[off + 8 : off + 8 + len(header)] = header
+        off = _align(off + 8 + len(header))
+        for r in raws:
+            mm[off : off + 8] = struct.pack("<Q", r.nbytes)
+            off = _align(off + 8)
+            mm[off : off + r.nbytes] = r
+            off = _align(off + r.nbytes)
+        with self._lock:
+            self._segments[name] = seg
+        return size
+
+    def put_raw(self, name: str, header: bytes, raws: List[memoryview]) -> int:
+        """Like put() but for pre-serialized (header, buffers)."""
+        size = _align(8 + len(header))
+        for r in raws:
+            size += _align(8) + _align(r.nbytes)
+        seg = MappedSegment(self._path(name), size=size, create=True)
+        mm = seg.mm
+        mm[0:8] = struct.pack("<Q", len(header))
+        mm[8 : 8 + len(header)] = header
+        off = _align(8 + len(header))
+        for r in raws:
+            mm[off : off + 8] = struct.pack("<Q", r.nbytes)
+            off = _align(off + 8)
+            mm[off : off + r.nbytes] = r
+            off = _align(off + r.nbytes)
+        with self._lock:
+            self._segments[name] = seg
+        return size
+
+    def get(self, name: str) -> Any:
+        """Map the segment and deserialize zero-copy (buffers view the mmap)."""
+        with self._lock:
+            seg = self._segments.get(name)
+            if seg is None:
+                seg = MappedSegment(self._path(name))
+                self._segments[name] = seg
+        mm = seg.mm
+        view = memoryview(mm)
+        (hlen,) = struct.unpack_from("<Q", mm, 0)
+        header = bytes(view[8 : 8 + hlen])
+        off = _align(8 + hlen)
+        buffers: List[memoryview] = []
+        while off < seg.size:
+            (blen,) = struct.unpack_from("<Q", mm, off)
+            off = _align(off + 8)
+            buffers.append(view[off : off + blen])
+            off = _align(off + blen)
+        return serialization.loads_oob(header, buffers)
+
+    def contains(self, name: str) -> bool:
+        return name in self._segments or os.path.exists(self._path(name))
+
+    def free(self, name: str) -> None:
+        with self._lock:
+            seg = self._segments.pop(name, None)
+        # The mmap stays valid for existing views even after unlink.
+        try:
+            os.unlink(self._path(name))
+        except FileNotFoundError:
+            pass
+
+    def estimate_size(self, obj: Any) -> int:
+        """Cheap size probe used to pick inline vs shm path."""
+        try:
+            import numpy as np
+
+            if isinstance(obj, np.ndarray):
+                return obj.nbytes
+        except Exception:
+            pass
+        return -1  # unknown; caller serializes and checks

@@ -1,0 +1,55 @@
+"""Wire protocol between clients (driver/workers) and the control hub.
+
+The reference splits control flow across gRPC services (GCS, raylet,
+worker-to-worker; reference: src/ray/protobuf/*.proto, 21 files). On a
+TPU host the control plane is node-local, so we use framed pickle over
+AF_UNIX sockets (multiprocessing.connection) — one hub, star topology.
+Bulk data never rides these messages; it goes through the shm object
+store (object_store.py).
+
+Every message is a (msg_type:str, payload:dict) pair encoded with
+serialization.dumps_inline.
+"""
+
+# client -> hub
+HELLO = "hello"
+SUBMIT_TASK = "submit_task"
+PUT = "put"
+GET = "get"
+WAIT = "wait"
+FREE = "free"
+CREATE_ACTOR = "create_actor"
+SUBMIT_ACTOR_TASK = "submit_actor_task"
+KILL_ACTOR = "kill_actor"
+CANCEL = "cancel"
+REGISTER_FUNCTION = "register_function"
+GET_FUNCTION = "get_function"
+KV_PUT = "kv_put"
+KV_GET = "kv_get"
+KV_DEL = "kv_del"
+KV_KEYS = "kv_keys"
+CREATE_PG = "create_pg"
+REMOVE_PG = "remove_pg"
+PG_READY = "pg_ready"
+GET_ACTOR = "get_actor"
+LIST_STATE = "list_state"
+CLUSTER_RESOURCES = "cluster_resources"
+SHUTDOWN = "shutdown"
+
+# worker -> hub
+TASK_DONE = "task_done"
+ACTOR_READY = "actor_ready"
+
+# hub -> worker
+EXEC_TASK = "exec_task"
+EXEC_ACTOR_CREATE = "exec_actor_create"
+EXEC_ACTOR_TASK = "exec_actor_task"
+KILL = "kill"
+
+# hub -> client
+REPLY = "reply"
+
+# object value kinds (in GET replies and TASK_DONE returns)
+VAL_INLINE = "inline"  # payload = serialized bytes
+VAL_SHM = "shm"  # payload = segment name
+VAL_ERROR = "error"  # payload = serialized exception

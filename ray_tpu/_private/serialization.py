@@ -1,0 +1,62 @@
+"""Serialization for task args, results, and functions.
+
+Mirrors the split the reference makes (reference:
+python/ray/_private/serialization.py:122 SerializationContext):
+
+- *functions/closures* go through cloudpickle (pickle-by-value), exported
+  once per function and cached by the receiving worker (reference:
+  python/ray/_private/function_manager.py:58).
+- *data* goes through stdlib pickle protocol 5 with out-of-band buffers
+  so numpy/jax arrays are not copied into the pickle stream; falls back
+  to cloudpickle when the payload contains closures.
+
+The wire format is a (header_bytes, [buffer, ...]) pair; buffers can be
+placed into shared memory by the object store for zero-copy cross-process
+transfer.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+PICKLE5 = 5
+
+
+def dumps_oob(obj: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+    """Serialize with out-of-band buffers. Returns (header, buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    try:
+        header = pickle.dumps(obj, protocol=PICKLE5, buffer_callback=buffers.append)
+        return b"P" + header, buffers
+    except Exception:
+        buffers.clear()
+        header = cloudpickle.dumps(obj, protocol=PICKLE5, buffer_callback=buffers.append)
+        return b"C" + header, buffers
+
+
+def loads_oob(header: bytes, buffers: List[Any]) -> Any:
+    return pickle.loads(header[1:], buffers=buffers)
+
+
+def dumps_function(fn: Any) -> bytes:
+    """Serialize a function/class by value (closures included)."""
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(blob: bytes) -> Any:
+    return cloudpickle.loads(blob)
+
+
+def dumps_inline(obj: Any) -> bytes:
+    """One-shot serialize (no out-of-band buffers) for small control data."""
+    try:
+        return b"P" + pickle.dumps(obj, protocol=PICKLE5)
+    except Exception:
+        return b"C" + cloudpickle.dumps(obj)
+
+
+def loads_inline(blob: bytes) -> Any:
+    return pickle.loads(blob[1:])

@@ -1,0 +1,232 @@
+"""Driver-process global runtime: init/shutdown and the public verbs.
+
+Parity: python/ray/_private/worker.py in the reference (ray.init :1286,
+ray.get :2718, ray.put :2854, ray.wait :2919, ray.kill :3099). The
+driver hosts the control hub in-process (a thread) instead of spawning
+gcs_server/raylet binaries — on a single TPU host there is no benefit
+to extra control processes, and it makes `init()` ~instant.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import tempfile
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from .. import exceptions
+from ..object_ref import ObjectRef
+from .client import CoreClient
+from .hub import Hub
+from .ids import ObjectID
+
+_lock = threading.RLock()
+_client: Optional[CoreClient] = None
+_hub: Optional[Hub] = None
+_session_dir: Optional[str] = None
+_is_worker = False
+
+
+def _set_global_client(client: CoreClient) -> None:
+    """Called by worker_process to make the API work inside tasks."""
+    global _client, _is_worker
+    _client = client
+    _is_worker = True
+
+
+def is_initialized() -> bool:
+    return _client is not None
+
+
+def get_client() -> CoreClient:
+    if _client is None:
+        init()
+    return _client
+
+
+def _detect_num_tpus() -> int:
+    env = os.environ.get("RAY_TPU_NUM_TPUS")
+    if env is not None:
+        return int(env)
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            return sum(1 for d in jax.devices() if d.platform in ("tpu", "axon"))
+        except Exception:
+            return 0
+    return 0
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    num_gpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    namespace: Optional[str] = None,
+    ignore_reinit_error: bool = False,
+    max_workers: Optional[int] = None,
+    worker_env: Optional[Dict[str, str]] = None,
+    **kwargs,
+):
+    """Start the single-host runtime (hub thread + on-demand worker pool)."""
+    global _client, _hub, _session_dir
+    with _lock:
+        if _client is not None:
+            if ignore_reinit_error or _is_worker:
+                return RuntimeContext()
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+        import sys
+
+        # The hub thread shares this process's GIL; a shorter switch interval
+        # keeps control-plane latency low under CPU-bound driver code.
+        sys.setswitchinterval(0.001)
+        ncpu = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
+        ntpu = num_tpus if num_tpus is not None else _detect_num_tpus()
+        res: Dict[str, float] = {"CPU": float(ncpu)}
+        if ntpu:
+            res["TPU"] = float(ntpu)
+        if num_gpus:
+            res["GPU"] = float(num_gpus)
+        res["memory"] = float(kwargs.get("_memory", 64 * 1024**3))
+        if resources:
+            res.update(resources)
+        base = os.environ.get("RAY_TPU_TMPDIR") or (
+            "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+        )
+        _session_dir = os.path.join(base, f"ray_tpu_{uuid.uuid4().hex[:12]}")
+        os.makedirs(_session_dir, exist_ok=True)
+        _hub = Hub(
+            _session_dir,
+            res,
+            max_workers=max_workers,
+            tpu_chip_ids=list(range(int(ntpu))) if ntpu else [],
+            worker_env=worker_env,
+        )
+        _hub.start()
+        _client = CoreClient(_hub.addr, _session_dir, role="driver", worker_id="driver")
+        atexit.register(shutdown)
+        return RuntimeContext()
+
+
+def shutdown() -> None:
+    global _client, _hub, _session_dir
+    with _lock:
+        if _is_worker:
+            return
+        if _client is not None:
+            _client.close()
+            _client = None
+        if _hub is not None:
+            _hub.shutdown()
+            _hub = None
+        if _session_dir is not None:
+            shutil.rmtree(_session_dir, ignore_errors=True)
+            _session_dir = None
+        try:
+            atexit.unregister(shutdown)
+        except Exception:
+            pass
+
+
+class RuntimeContext:
+    """Returned by init(); mirrors ray's RayContext/RuntimeContext."""
+
+    @property
+    def address_info(self) -> dict:
+        return {"session_dir": _session_dir, "address": _hub.addr if _hub else None}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        shutdown()
+
+
+# --------------------------------------------------------------------- verbs
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed.")
+    client = get_client()
+    oid = client.put_value(value)
+    return ObjectRef(oid)
+
+
+def get(
+    refs: Union[ObjectRef, Sequence[ObjectRef]],
+    *,
+    timeout: Optional[float] = None,
+) -> Any:
+    client = get_client()
+    if isinstance(refs, ObjectRef):
+        return client.get([refs._id], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or list of ObjectRefs, got {type(refs)}")
+    if not refs:
+        return []
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() list elements must be ObjectRefs, got {type(r)}")
+    return client.get([r._id for r in refs], timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns <= 0:
+        raise ValueError("num_returns must be > 0")
+    client = get_client()
+    ready, not_ready = client.wait([r._id for r in refs], num_returns, timeout, fetch_local)
+    by_id = {r._id.binary(): r for r in refs}
+    return [by_id[b] for b in ready], [by_id[b] for b in not_ready]
+
+
+def kill(actor, *, no_restart: bool = True) -> None:
+    from ..actor import ActorHandle
+
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    get_client().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    get_client().cancel(ref._id, force=force)
+
+
+def free(refs: Sequence[ObjectRef]) -> None:
+    get_client().free([r._id for r in refs])
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    from ..actor import ActorHandle
+    from .ids import ActorID
+
+    aid = get_client().get_named_actor(name, namespace)
+    if aid is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    return ActorHandle(ActorID(aid))
+
+
+def available_resources() -> Dict[str, float]:
+    return get_client().cluster_resources(available=True)
+
+
+def cluster_resources() -> Dict[str, float]:
+    return get_client().cluster_resources(available=False)
+
+
+def nodes() -> List[dict]:
+    return get_client().list_state("nodes")

@@ -1,0 +1,223 @@
+"""Worker process: executes tasks and hosts actors.
+
+The analogue of the reference's default_worker.py + the execution half
+of CoreWorker (reference: python/ray/_private/workers/default_worker.py,
+src/ray/core_worker/transport/task_receiver.h). One process executes one
+task at a time; an actor pins its process for its lifetime (the
+reference's WorkerPool does the same, src/ray/raylet/worker_pool.h).
+
+Concurrency model per the reference's scheduling queues
+(src/ray/core_worker/transport/):
+  - plain tasks and sync actors: strict FIFO on the main executor thread
+    (ActorSchedulingQueue ordering),
+  - actors with max_concurrency>1: a thread pool (concurrency groups),
+  - async actors (coroutine methods): a persistent asyncio event loop,
+    many calls in flight (the reference runs async actors on an asyncio
+    loop owned by the core worker).
+
+TPU chip visibility: the hub assigns chip ids at dispatch; we export
+TPU_VISIBLE_CHIPS before user code first imports jax (the reference's
+TPUAcceleratorManager.set_current_process_visible_accelerators —
+python/ray/_private/accelerators/tpu.py:193 — does the same).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from . import protocol as P
+from .client import CoreClient
+from .serialization import dumps_inline, loads_function, loads_inline
+
+
+class WorkerRuntime:
+    def __init__(self, client: CoreClient):
+        self.client = client
+        self.fn_cache: Dict[str, Any] = {}
+        self.actor_instance: Any = None
+        self.actor_id: Optional[bytes] = None
+        self.pool: Optional[ThreadPoolExecutor] = None
+        self.aio_loop: Optional[asyncio.AbstractEventLoop] = None
+
+    # ----------------------------------------------------------- arg decode
+    def _decode_args(self, args_kind: str, args_payload: Any):
+        if args_kind == "inline":
+            args, kwargs = loads_inline(args_payload)
+        else:  # "ref": oversized arg tuple was spilled to the object store
+            from .ids import ObjectID
+
+            args, kwargs = self.client.get([ObjectID(args_payload)])[0]
+        args = tuple(self._resolve(a) for a in args)
+        kwargs = {k: self._resolve(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _resolve(self, v):
+        from ..object_ref import ObjectRef
+
+        if isinstance(v, ObjectRef):
+            return self.client.get([v._id])[0]
+        return v
+
+    def _get_fn(self, fn_id: str, fn_blob):
+        fn = self.fn_cache.get(fn_id)
+        if fn is None:
+            if fn_blob is None:
+                reply = self.client.request(P.GET_FUNCTION, {"fn_id": fn_id})
+                fn_blob = reply["blob"]
+            fn = loads_function(fn_blob)
+            self.fn_cache[fn_id] = fn
+        return fn
+
+    def _store_returns(self, return_ids, result, num_expected):
+        from .ids import ObjectID
+
+        if num_expected == 1:
+            values = [result]
+        elif num_expected == 0:
+            values = []
+        else:
+            values = list(result)
+            if len(values) != num_expected:
+                raise ValueError(
+                    f"task declared num_returns={num_expected} but returned {len(values)} values"
+                )
+        out = []
+        for oid_bytes, val in zip(return_ids, values):
+            kind, payload, size = self.client.encode_value(ObjectID(oid_bytes), val)
+            out.append((oid_bytes, kind, payload, size))
+        return out
+
+    def _error_returns(self, return_ids, fn_name: str):
+        from ..exceptions import TaskError
+
+        tb = traceback.format_exc()
+        exc_type, exc, _ = sys.exc_info()
+        err = TaskError(fn_name, tb, cause=None)
+        try:
+            blob = dumps_inline(err)
+        except Exception:
+            blob = dumps_inline(TaskError(fn_name, tb))
+        return [(oid, P.VAL_ERROR, blob, 0) for oid in return_ids]
+
+    # ------------------------------------------------------------ execution
+    def exec_task(self, p: dict):
+        if p.get("tpu_chips"):
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in p["tpu_chips"])
+        fn_name = p["fn_id"]
+        try:
+            fn = self._get_fn(p["fn_id"], p.get("fn_blob"))
+            fn_name = getattr(fn, "__name__", fn_name)
+            args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
+            result = fn(*args, **kwargs)
+            returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
+        except Exception:
+            returns = self._error_returns(p["return_ids"], fn_name)
+        self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
+
+    def exec_actor_create(self, p: dict):
+        if p.get("tpu_chips"):
+            os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in p["tpu_chips"])
+        try:
+            cls = self._get_fn(p["fn_id"], p.get("fn_blob"))
+            args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
+            self.actor_instance = cls(*args, **kwargs)
+            self.actor_id = p["actor_id"]
+            maxc = (p.get("options") or {}).get("max_concurrency") or 1
+            if maxc > 1:
+                self.pool = ThreadPoolExecutor(max_workers=maxc)
+            self.client.send(P.ACTOR_READY, {"actor_id": p["actor_id"], "error": None})
+        except Exception:
+            from ..exceptions import TaskError
+
+            err = TaskError(p["fn_id"], traceback.format_exc())
+            self.client.send(
+                P.ACTOR_READY, {"actor_id": p["actor_id"], "error": dumps_inline(err)}
+            )
+
+    def _run_actor_method(self, p: dict):
+        method_name = p["method"]
+        try:
+            if method_name == "__ray_ready__":
+                result = None
+            elif method_name == "__ray_terminate__":
+                self.client.send(
+                    P.TASK_DONE,
+                    {
+                        "task_id": p["task_id"],
+                        "returns": self._store_returns(p["return_ids"], None, len(p["return_ids"])),
+                    },
+                )
+                os._exit(0)
+            else:
+                method = getattr(self.actor_instance, method_name)
+                args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
+                result = method(*args, **kwargs)
+            returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
+        except Exception:
+            returns = self._error_returns(p["return_ids"], method_name)
+        self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
+
+    def _ensure_aio_loop(self):
+        if self.aio_loop is None:
+            self.aio_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self.aio_loop.run_forever, daemon=True, name="actor-aio")
+            t.start()
+        return self.aio_loop
+
+    def exec_actor_task(self, p: dict):
+        method = getattr(type(self.actor_instance), p["method"], None) if p["method"] not in (
+            "__ray_ready__",
+            "__ray_terminate__",
+        ) else None
+        if method is not None and asyncio.iscoroutinefunction(method):
+            loop = self._ensure_aio_loop()
+
+            async def run():
+                try:
+                    args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
+                    result = await method(self.actor_instance, *args, **kwargs)
+                    returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
+                except Exception:
+                    returns = self._error_returns(p["return_ids"], p["method"])
+                self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
+
+            asyncio.run_coroutine_threadsafe(run(), loop)
+        elif self.pool is not None:
+            self.pool.submit(self._run_actor_method, p)
+        else:
+            self._run_actor_method(p)
+
+
+def main():
+    sys.setswitchinterval(0.001)
+    hub_addr = os.environ["RAY_TPU_HUB_ADDR"]
+    session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    client = CoreClient(hub_addr, session_dir, role="worker", worker_id=worker_id)
+
+    # make ray_tpu.* API work inside tasks (auto-connect)
+    from . import worker as worker_mod
+
+    worker_mod._set_global_client(client)
+
+    rt = WorkerRuntime(client)
+    while True:
+        msg_type, payload = client.task_queue.get()
+        if msg_type == P.KILL:
+            os._exit(0)
+        elif msg_type == P.EXEC_TASK:
+            rt.exec_task(payload)
+        elif msg_type == P.EXEC_ACTOR_CREATE:
+            rt.exec_actor_create(payload)
+        elif msg_type == P.EXEC_ACTOR_TASK:
+            rt.exec_actor_task(payload)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""Actors: stateful workers with ordered method-call semantics.
+
+Parity: python/ray/actor.py in the reference (ActorClass :617,
+ActorHandle :1287, ActorMethod :116). An actor pins a worker process for
+its lifetime; calls are FIFO per-caller (ordered queue, reference:
+src/ray/core_worker/transport/actor_task_submitter.h:78), optionally
+concurrent via max_concurrency or asyncio for coroutine methods.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Optional
+
+from ._private.ids import ActorID
+from ._private.serialization import dumps_function
+from .object_ref import ObjectRef
+from .remote_function import canonical_resources, encode_args, scheduling_options
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, options: Optional[dict] = None):
+        self._handle = handle
+        self._name = name
+        self._options = dict(options or {})
+
+    def options(self, **opts) -> "ActorMethod":
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorMethod(self._handle, self._name, merged)
+
+    def remote(self, *args, **kwargs):
+        from ._private import worker
+
+        client = worker.get_client()
+        args_kind, args_payload, deps = encode_args(client, args, kwargs)
+        num_returns = self._options.get("num_returns", 1)
+        return_ids = client.submit_actor_task(
+            self._handle._actor_id,
+            self._name,
+            args_kind,
+            args_payload,
+            deps,
+            num_returns,
+            scheduling_options(self._options),
+        )
+        refs = [ObjectRef(r) for r in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"Actor method '{self._name}' must be called with .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, ready_ref: Optional[ObjectRef] = None):
+        object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_ready_ref", ready_ref)
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __ray_ready__(self) -> ObjectRef:
+        return ActorMethod(self, "__ray_ready__").remote()
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()})"
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id.binary(),))
+
+
+def _rebuild_handle(actor_id_bytes: bytes) -> ActorHandle:
+    return ActorHandle(ActorID(actor_id_bytes))
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        self._blob = None
+        self._fn_id: Optional[str] = None
+        self.__name__ = getattr(cls, "__name__", "Actor")
+        self.__doc__ = getattr(cls, "__doc__", None)
+
+    def options(self, **opts) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(opts)
+        ac = ActorClass(self._cls, merged)
+        ac._blob = self._blob
+        ac._fn_id = self._fn_id
+        return ac
+
+    def _ensure_exported(self, client) -> str:
+        if self._blob is None:
+            self._blob = dumps_function(self._cls)
+            digest = hashlib.sha1(self._blob).hexdigest()[:16]
+            self._fn_id = f"{self.__name__}:{digest}"
+        client.register_function(self._fn_id, self._blob)
+        return self._fn_id
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        from ._private import worker
+
+        client = worker.get_client()
+        opts = self._options
+        fn_id = self._ensure_exported(client)
+        args_kind, args_payload, deps = encode_args(client, args, kwargs)
+        resources = canonical_resources(opts, is_actor=True)
+        options = scheduling_options(opts)
+        options["max_restarts"] = opts.get("max_restarts", 0)
+        options["max_concurrency"] = opts.get("max_concurrency", 1)
+        if opts.get("name"):
+            options["name"] = opts["name"]
+            options["namespace"] = opts.get("namespace")
+        options["lifetime"] = opts.get("lifetime")
+        actor_id, ready_id = client.create_actor(
+            fn_id, args_kind, args_payload, deps, resources, options
+        )
+        return ActorHandle(ActorID(actor_id.binary()), ObjectRef(ready_id))
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Actor class '{self.__name__}' cannot be instantiated directly; "
+            f"use '{self.__name__}.remote()'."
+        )

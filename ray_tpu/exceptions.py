@@ -1,0 +1,79 @@
+"""User-visible exceptions.
+
+Parity with the reference's python/ray/exceptions.py: RayError,
+RayTaskError, RayActorError/ActorDiedError, GetTimeoutError,
+WorkerCrashedError, ObjectLostError, TaskCancelledError.
+"""
+
+from __future__ import annotations
+
+
+class RayError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayError):
+    """A task raised an exception during execution.
+
+    Carries the remote traceback; re-raised at every `get()` on the
+    task's return refs (reference behavior: python/ray/exceptions.py
+    RayTaskError wraps the cause and as_instanceof_cause()).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        return (TaskError, (self.function_name, self.traceback_str, self.cause))
+
+
+class WorkerCrashedError(RayError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead; pending and future calls fail with this."""
+
+    def __init__(self, actor_id=None, msg: str = "The actor died."):
+        self.actor_id = actor_id
+        self.msg = msg
+        super().__init__(msg)
+
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id, self.msg))
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unavailable (e.g. restarting)."""
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    """`get(timeout=...)` expired before the object became available."""
+
+
+class ObjectLostError(RayError):
+    """The object's value was lost and could not be reconstructed."""
+
+
+class TaskCancelledError(RayError):
+    """The task was cancelled before/while running."""
+
+
+class RuntimeEnvSetupError(RayError):
+    """Preparing the runtime environment for a task/actor failed."""
+
+
+class OutOfMemoryError(RayError):
+    """A worker was killed by the memory monitor."""
+
+
+# Reference-compatible aliases
+RayTaskError = TaskError
+RayActorError = ActorError

@@ -1,0 +1,69 @@
+"""ObjectRef: a future for a value in the distributed object store.
+
+Parity: python/ray/includes/object_ref.pxi / ray.ObjectRef in the
+reference. Refs are cheap value objects (an id); they re-bind to the
+current process's core client when unpickled, so they can flow through
+task args, actor calls, and nested data structures.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Any, Optional
+
+from ._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "__weakref__")
+
+    def __init__(self, object_id: ObjectID):
+        self._id = object_id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        return (_rebuild_ref, (self._id.binary(),))
+
+    # -- convenience -----------------------------------------------------
+    def get(self, timeout: Optional[float] = None) -> Any:
+        from ._private import worker
+
+        return worker.get(self, timeout=timeout)
+
+    def future(self) -> Future:
+        """A concurrent.futures.Future resolving to the object's value."""
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get())
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        """Support `await ref` inside async actors."""
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _rebuild_ref(id_bytes: bytes) -> ObjectRef:
+    return ObjectRef(ObjectID(id_bytes))

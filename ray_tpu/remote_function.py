@@ -1,0 +1,158 @@
+"""RemoteFunction: the object `@remote` turns a function into.
+
+Parity: python/ray/remote_function.py:41 in the reference. The function
+is cloudpickled once per process and exported to the hub's function
+table keyed by a content digest (the reference exports via GCS KV,
+python/ray/_private/function_manager.py:196); workers fetch + cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ._private.object_store import INLINE_THRESHOLD
+from ._private.serialization import dumps_function, dumps_inline
+from .object_ref import ObjectRef
+
+# Options accepted by @remote / .options() — superset kept aligned with
+# the reference's ray_option_utils.py validation table.
+_TASK_OPTION_KEYS = {
+    "num_cpus",
+    "num_gpus",
+    "num_tpus",
+    "resources",
+    "num_returns",
+    "max_retries",
+    "retry_exceptions",
+    "name",
+    "scheduling_strategy",
+    "runtime_env",
+    "memory",
+    "max_calls",
+    "_metadata",
+}
+
+
+def canonical_resources(opts: Dict[str, Any], is_actor: bool) -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    ncpu = opts.get("num_cpus")
+    if ncpu is None:
+        ncpu = 0 if is_actor else 1
+    if ncpu:
+        res["CPU"] = float(ncpu)
+    if opts.get("num_gpus"):
+        res["GPU"] = float(opts["num_gpus"])
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("memory"):
+        res["memory"] = float(opts["memory"])
+    for k, v in (opts.get("resources") or {}).items():
+        res[k] = float(v)
+    return res
+
+
+def encode_args(client, args: tuple, kwargs: dict):
+    """Encode call args: spill large ndarray/bytes args to the object store,
+    collect top-level ObjectRef dependencies, inline the rest.
+
+    Mirrors the reference's arg handling: small args inline with the task
+    spec, large args become owned objects passed by reference
+    (python/ray/_raylet.pyx prepare_args)."""
+    import numpy as np
+
+    deps: List[bytes] = []
+
+    def spill(v):
+        if isinstance(v, ObjectRef):
+            deps.append(v._id.binary())
+            return v
+        big = False
+        if isinstance(v, np.ndarray) and v.nbytes > INLINE_THRESHOLD:
+            big = True
+        elif isinstance(v, (bytes, bytearray)) and len(v) > INLINE_THRESHOLD:
+            big = True
+        if big:
+            ref = ObjectRef(client.put_value(v))
+            deps.append(ref._id.binary())
+            return ref
+        return v
+
+    args = tuple(spill(a) for a in args)
+    kwargs = {k: spill(v) for k, v in kwargs.items()}
+    blob = dumps_inline((args, kwargs))
+    if len(blob) > INLINE_THRESHOLD:
+        oid = client.put_value((args, kwargs))
+        deps.append(oid.binary())
+        return "ref", oid.binary(), deps
+    return "inline", blob, deps
+
+
+def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
+    """Extract hub-visible scheduling options (placement group etc.)."""
+    out: Dict[str, Any] = {}
+    strategy = opts.get("scheduling_strategy")
+    if strategy is not None:
+        from .util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            out["placement_group"] = (pg.id.binary(), strategy.placement_group_bundle_index)
+        elif isinstance(strategy, str):
+            out["strategy"] = strategy
+    if opts.get("max_retries") is not None:
+        out["max_retries"] = opts["max_retries"]
+    return out
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(options or {})
+        self._fn_blob = None
+        self._fn_id: Optional[str] = None
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def _ensure_exported(self, client) -> str:
+        if self._fn_blob is None:
+            self._fn_blob = dumps_function(self._fn)
+            digest = hashlib.sha1(self._fn_blob).hexdigest()[:16]
+            self._fn_id = f"{self.__name__}:{digest}"
+        client.register_function(self._fn_id, self._fn_blob)
+        return self._fn_id
+
+    def options(self, **opts) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(opts)
+        rf = RemoteFunction(self._fn, merged)
+        rf._fn_blob = self._fn_blob
+        rf._fn_id = self._fn_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, opts):
+        from ._private import worker
+
+        client = worker.get_client()
+        fn_id = self._ensure_exported(client)
+        args_kind, args_payload, deps = encode_args(client, args, kwargs)
+        num_returns = opts.get("num_returns", 1)
+        resources = canonical_resources(opts, is_actor=False)
+        options = scheduling_options(opts)
+        options.setdefault("max_retries", opts.get("max_retries", 3))
+        return_ids = client.submit_task(
+            fn_id, args_kind, args_payload, deps, num_returns, resources, options
+        )
+        refs = [ObjectRef(r) for r in return_ids]
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; "
+            f"use '{self.__name__}.remote()'."
+        )

@@ -1,0 +1,47 @@
+"""Shared test fixtures.
+
+Pattern from the reference's conftest (python/ray/tests/conftest.py:580
+ray_start_regular, :497 shutdown_only): tests run against a real
+single-node runtime. JAX tests run on a virtual 8-device CPU mesh so
+multi-chip sharding logic is exercised without TPU hardware (the
+reference's analogue: fake NCCL groups / CPUCommunicator,
+python/ray/experimental/channel/cpu_communicator.py).
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS",
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+os.environ.setdefault("RAY_TPU_NUM_TPUS", "0")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=2, max_workers=2, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_4_cpus():
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=4, max_workers=4, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    import ray_tpu
+
+    yield None
+    ray_tpu.shutdown()

@@ -1,0 +1,198 @@
+"""Actor API tests.
+
+Modeled on the reference's python/ray/tests/test_actor.py and
+test_actor_failures.py: lifecycle, ordering, named actors, async
+actors, concurrency, kill/restart semantics.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def value(self):
+        return self.n
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(5)) == 6
+
+
+def test_actor_ctor_args(ray_start_regular):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.value.remote()) == 100
+
+
+def test_actor_call_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_actor_ctor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("ctor failed")
+
+        def f(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises((TaskError, ActorDiedError)):
+        ray_tpu.get(b.f.remote(), timeout=20)
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def boom(self):
+            raise ValueError("method boom")
+
+    a = A.remote()
+    with pytest.raises(TaskError, match="method boom"):
+        ray_tpu.get(a.boom.remote())
+
+
+def test_named_actor(ray_start_regular):
+    c = Counter.options(name="counter1").remote()
+    ray_tpu.get(c.inc.remote())
+    again = ray_tpu.get_actor("counter1")
+    assert ray_tpu.get(again.value.remote()) == 1
+
+
+def test_named_actor_duplicate(ray_start_regular):
+    a = Counter.options(name="dup").remote()
+    ray_tpu.get(a.inc.remote())
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_actor_missing(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("nope")
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(c.inc.remote(), timeout=20)
+
+
+def test_actor_handle_passing(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.inc.remote(10))
+
+    assert ray_tpu.get(use.remote(c)) == 10
+    assert ray_tpu.get(c.value.remote()) == 10
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, i):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return i * 2
+
+    a = AsyncWorker.remote()
+    assert ray_tpu.get([a.work.remote(i) for i in range(8)]) == [i * 2 for i in range(8)]
+
+
+def test_max_concurrency_threads(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def work(self):
+            time.sleep(0.3)
+            return 1
+
+    s = Slow.remote()
+    ray_tpu.get(s.work.remote(), timeout=30)  # wait for spawn + ctor
+    t0 = time.time()
+    ray_tpu.get([s.work.remote() for _ in range(4)])
+    # 4 concurrent 0.3s calls should take well under 4*0.3s
+    assert time.time() - t0 < 1.0
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    p = Phoenix.remote()
+    pid1 = ray_tpu.get(p.pid.remote())
+    ray_tpu.kill(p, no_restart=False)
+    # restarted actor loses state but accepts new calls
+    deadline = time.time() + 30
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(p.pid.remote(), timeout=10)
+            break
+        except (ActorDiedError, ray_tpu.exceptions.GetTimeoutError):
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+    assert ray_tpu.get(p.inc.remote()) == 1  # state reset
+
+
+def test_actor_pool(ray_start_regular):
+    from ray_tpu.util import ActorPool
+
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+    pool = ActorPool([Doubler.remote(), Doubler.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_kill_pending_actor(ray_start_regular):
+    """Killing a queued (not yet scheduled) actor cancels creation (review finding)."""
+
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(5)
+
+    @ray_tpu.remote(num_cpus=2)
+    class Big:
+        def ping(self):
+            return 1
+
+    b1, b2 = blocker.remote(), blocker.remote()
+    time.sleep(0.5)
+    a = Big.remote()  # cannot schedule: both CPUs busy
+    ray_tpu.kill(a)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.ping.remote(), timeout=20)

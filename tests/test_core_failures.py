@@ -1,0 +1,74 @@
+"""Failure handling: worker crashes, retries, cancellation.
+
+Modeled on the reference's tests/test_failure.py + test_actor_failures.py
+kill-process patterns (python/ray/_private/test_utils.py:572).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskCancelledError, TaskError, WorkerCrashedError
+
+
+def test_task_retry_on_worker_death(ray_start_regular):
+    @ray_tpu.remote(max_retries=2)
+    def flaky(marker_dir):
+        # die the first time, succeed on retry
+        marker = os.path.join(marker_dir, "ran_once")
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        assert ray_tpu.get(flaky.remote(d), timeout=60) == "recovered"
+
+
+def test_no_retry_exhausted(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_cancel_pending_task(ray_start_regular):
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(30)
+
+    @ray_tpu.remote
+    def target():
+        return 1
+
+    # fill both CPUs, then queue a task and cancel it while pending
+    b1, b2 = blocker.remote(), blocker.remote()
+    time.sleep(0.5)
+    t = target.remote()
+    ray_tpu.cancel(t)
+    with pytest.raises((TaskCancelledError, TaskError)):
+        ray_tpu.get(t, timeout=10)
+
+
+def test_application_error_not_retried(ray_start_regular):
+    calls_file = "/tmp/ray_tpu_test_calls_%d" % os.getpid()
+    if os.path.exists(calls_file):
+        os.unlink(calls_file)
+
+    @ray_tpu.remote(max_retries=3)
+    def app_error():
+        with open(calls_file, "a") as f:
+            f.write("x")
+        raise ValueError("app error")
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(app_error.remote())
+    # application errors are not retried (only worker crashes are)
+    assert os.path.getsize(calls_file) == 1
+    os.unlink(calls_file)

@@ -1,0 +1,192 @@
+"""Core task API tests.
+
+Modeled on the reference's python/ray/tests/test_basic.py coverage:
+remote functions, args/kwargs, ObjectRef passing, multiple returns,
+errors, nested tasks, wait, timeouts, large objects.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b):
+        return a + b
+
+    assert ray_tpu.get(f.remote(1, 2)) == 3
+
+
+def test_kwargs_and_defaults(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_object_ref_arg_resolution(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    r1 = f.remote(5)
+    r2 = f.remote(r1)  # top-level ref resolved to its value
+    assert ray_tpu.get(r2) == 20
+
+
+def test_put_get_roundtrip(ray_start_regular):
+    obj = {"a": [1, 2, 3], "b": "hello"}
+    assert ray_tpu.get(ray_tpu.put(obj)) == obj
+
+
+def test_put_on_ref_raises(ray_start_regular):
+    with pytest.raises(TypeError):
+        ray_tpu.put(ray_tpu.put(1))
+
+
+def test_large_object_zero_copy(ray_start_regular):
+    arr = np.arange(500_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(ref)) == float(arr.sum())
+    # the driver-side get should give back an equal array
+    got = ray_tpu.get(ref)
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_large_arg_auto_spill(ray_start_regular):
+    arr = np.ones(200_000, dtype=np.float64)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(arr)) == 200_000.0
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom")
+
+    with pytest.raises(TaskError, match="boom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom")
+
+    @ray_tpu.remote
+    def dependent(x):
+        return x
+
+    # the dependent task's get should surface the upstream error
+    with pytest.raises(TaskError):
+        ray_tpu.get(dependent.remote(boom.remote()))
+
+
+def test_nested_task_submission(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 100
+
+    assert ray_tpu.get(outer.remote(1)) == 102
+
+
+def test_wait_basic(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 2
+
+    refs = [fast.remote(), slow.remote()]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=4)
+    assert len(ready) == 1 and len(not_ready) == 1
+    assert ray_tpu.get(ready[0]) == 1
+
+
+def test_wait_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.2)
+    assert ready == [] and len(not_ready) == 1
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote(num_returns=1)
+    def f():
+        return 1, 2
+
+    a, b = f.options(num_returns=2).remote()
+    assert ray_tpu.get([a, b]) == [1, 2]
+
+
+def test_calling_remote_directly_raises(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_many_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(200)]
+    assert ray_tpu.get(refs) == [i * i for i in range(200)]
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 2.0
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= res["CPU"]
+
+
+def test_nodes(ray_start_regular):
+    ns = ray_tpu.nodes()
+    assert len(ns) == 1 and ns[0]["alive"]

@@ -1,0 +1,135 @@
+"""Placement group tests (reference: python/ray/tests/test_placement_group.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+def test_pg_create_and_ready(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    remove_placement_group(pg)
+
+
+def test_pg_reserves_resources(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.wait(10)
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) == 0.0
+    remove_placement_group(pg)
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == 2.0
+
+
+def test_task_in_pg_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return "ok"
+
+    r = where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    ).remote()
+    assert ray_tpu.get(r, timeout=30) == "ok"
+    remove_placement_group(pg)
+
+
+def test_actor_in_pg(ray_start_regular):
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0)).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_pg_ready_object_ref(ray_start_regular):
+    pg = placement_group([{"CPU": 1}])
+    assert ray_tpu.get(pg.ready(), timeout=30) is True
+    remove_placement_group(pg)
+
+
+def test_pg_table(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="SPREAD", name="mypg")
+    pg.wait(10)
+    table = placement_group_table()
+    assert any(v["strategy"] == "SPREAD" for v in table.values())
+    remove_placement_group(pg)
+
+
+def test_pg_invalid_strategy(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="BOGUS")
+
+
+def test_pg_bundle_exclusive(ray_start_regular):
+    # PG reserves all CPUs; a plain task cannot run until PG removed
+    pg = placement_group([{"CPU": 2}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    ready, not_ready = ray_tpu.wait([f.remote()], num_returns=1, timeout=1.0)
+    assert not ready  # blocked: no free CPUs outside the PG
+    remove_placement_group(pg)
+
+
+def test_pg_task_queues_until_ready(ray_start_regular):
+    """A task in an unreserved PG must queue, not run (review finding)."""
+    import time
+
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(3)
+
+    # occupy both CPUs so the PG cannot reserve
+    b1, b2 = blocker.remote(), blocker.remote()
+    time.sleep(0.5)
+    pg = placement_group([{"CPU": 2}])
+
+    @ray_tpu.remote(num_cpus=1)
+    def in_pg():
+        return "ran"
+
+    r = in_pg.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, 0)
+    ).remote()
+    ready, _ = ray_tpu.wait([r], num_returns=1, timeout=0.5)
+    assert not ready  # must not run before the PG is reserved
+    assert ray_tpu.get(r, timeout=30) == "ran"  # runs once blockers finish
+    remove_placement_group(pg)
+
+
+def test_pg_invalid_bundle_index_fails_task(ray_start_regular):
+    """Out-of-range bundle index fails the task, not the hub (review finding)."""
+    pg = placement_group([{"CPU": 1}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    r = f.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=7)
+    ).remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(r, timeout=10)
+    # hub must still be alive
+    assert ray_tpu.get(f.remote(), timeout=30) == 1
+    remove_placement_group(pg)
