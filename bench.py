@@ -1,0 +1,111 @@
+"""Headline benchmark: Llama training throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no LLM-training numbers (BASELINE.md: north-star
+targets "to be established by our harness"), so ``vs_baseline`` is
+hardware-normalized: measured MFU divided by 0.50 — the MFU an
+A100-class baseline (the north star's comparison hardware) typically
+sustains on dense decoder training. vs_baseline >= 1.0 means we extract
+at least as much of the silicon as the reference stack would.
+
+Model: ~1.1B-param Llama (TinyLlama shape), bf16 params, remat on,
+seq 2048 — big enough that MXU utilization is meaningful on one chip,
+small enough to fit one v5e's 16 GiB HBM with Adam state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from functools import partial
+
+
+# bf16 peak TFLOPs per chip by TPU generation (public spec sheets).
+PEAK_TFLOPS = {"v5e": 197.0, "v5p": 459.0, "v4": 275.0, "v6e": 918.0}
+
+
+def _detect_peak() -> float:
+    import os
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    for key, val in PEAK_TFLOPS.items():
+        if key in gen:
+            return val
+    return PEAK_TFLOPS["v5e"]
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu import parallel
+    from ray_tpu.models import llama
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:
+        cfg = dataclasses.replace(llama.LLAMA_TINY)
+        batch, seq, steps = 4, 128, 3
+    else:
+        cfg = dataclasses.replace(
+            llama.LLAMA_BENCH, param_dtype=jnp.bfloat16, remat=True
+        )
+        batch, seq, steps = 8, 2048, 10
+
+    mesh = parallel.make_mesh(devices=jax.devices())
+    opt = parallel.default_optimizer(1e-4, warmup_steps=10, total_steps=1000)
+    state, state_sh = parallel.create_train_state(
+        mesh, jax.random.PRNGKey(0),
+        lambda r: llama.init_params(r, cfg), opt, llama.param_specs(cfg),
+    )
+    step = parallel.make_train_step(
+        partial(llama.loss_fn, config=cfg), opt, mesh, state_sh
+    )
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab_size,
+        dtype=jnp.int32,
+    )
+    batch_dict = {"tokens": tokens}
+
+    # Warmup / compile.
+    state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_dict)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    n_chips = len(jax.devices())
+    tps_chip = tokens_per_sec / n_chips
+
+    flops_tok = llama.flops_per_token(cfg, seq)
+    achieved_tflops = tokens_per_sec * flops_tok / n_chips / 1e12
+    peak = _detect_peak() if not on_cpu else 1.0
+    mfu = achieved_tflops / peak
+
+    print(json.dumps({
+        "metric": "llama1b_train_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.50, 3),
+        "detail": {
+            "model_params": llama.param_count(cfg),
+            "batch": batch, "seq": seq, "steps": steps,
+            "achieved_tflops_per_chip": round(achieved_tflops, 1),
+            "mfu": round(mfu, 3),
+            "n_chips": n_chips,
+            "platform": jax.devices()[0].platform,
+            "loss": round(float(metrics["loss"]), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+"""Model zoo: TPU-first reference models used by Train/Serve/Data/RLlib.
+
+The reference (Ray) delegates model code to torch/vLLM downstream; this
+framework ships JAX-native models so its ML libraries have first-class
+workloads (flagship: Llama — BASELINE.json north star).
+"""
+
+from . import llama
+from .llama import (
+    LLAMA_2_7B,
+    LLAMA_3_8B,
+    LLAMA_3_70B,
+    LLAMA_BENCH,
+    LLAMA_TINY,
+    LlamaConfig,
+)
+
+__all__ = [
+    "llama",
+    "LlamaConfig",
+    "LLAMA_2_7B",
+    "LLAMA_3_8B",
+    "LLAMA_3_70B",
+    "LLAMA_BENCH",
+    "LLAMA_TINY",
+]

@@ -1,0 +1,7 @@
+"""TPU compute kernels: flash/ring attention, MoE dispatch, collective
+helpers. XLA blockwise fallbacks keep every op runnable on the CPU test
+mesh; Pallas kernels take over on real TPU."""
+
+from .attention import flash_attention
+
+__all__ = ["flash_attention"]

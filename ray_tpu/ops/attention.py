@@ -1,0 +1,121 @@
+"""Memory-efficient causal attention (flash-attention algorithm).
+
+Online-softmax blockwise attention: O(S) memory instead of the O(S^2)
+logits tensor. Two code paths behind one signature:
+
+- ``flash_attention`` — blockwise `lax.scan` formulation that XLA fuses
+  well on any backend (and is the CPU-mesh test path).
+- A Pallas TPU kernel (ray_tpu.ops.pallas_attention) is substituted on
+  TPU when available; same semantics, hand-tiled for MXU/VMEM.
+
+Supports GQA (n_kv_heads divides n_heads). Layout: q (B, S, H, hd),
+k/v (B, T, KVH, hd) — the layout ray_tpu.models uses.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _blockwise_attention(q, k, v, *, causal: bool, block_q: int, block_kv: int,
+                         q_offset: int = 0, kv_offset: int = 0):
+    """Core online-softmax loop. Shapes:
+    q (B, Sq, KVH, G, hd), k/v (B, Skv, KVH, hd). fp32 accumulation.
+    ``q_offset``/``kv_offset`` are absolute position offsets (used by
+    ring attention, where each shard holds a slice of the sequence).
+    """
+    B, Sq, KVH, G, hd = q.shape
+    Skv = k.shape[1]
+    scale = 1.0 / math.sqrt(hd)
+    nq = max(1, Sq // block_q)
+    nkv = max(1, Skv // block_kv)
+    block_q = Sq // nq
+    block_kv = Skv // nkv
+
+    qb = q.reshape(B, nq, block_q, KVH, G, hd)
+    kb = k.reshape(B, nkv, block_kv, KVH, hd)
+    vb = v.reshape(B, nkv, block_kv, KVH, hd)
+
+    q_pos = q_offset + jnp.arange(Sq).reshape(nq, block_q)
+    kv_pos = kv_offset + jnp.arange(Skv).reshape(nkv, block_kv)
+
+    def per_qblock(qi, q_blk):
+        # q_blk: (B, block_q, KVH, G, hd)
+        acc0 = jnp.zeros((B, block_q, KVH, G, hd), jnp.float32)
+        m0 = jnp.full((B, block_q, KVH, G), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B, block_q, KVH, G), jnp.float32)
+
+        def body(carry, inputs):
+            acc, m, l = carry
+            ki, k_blk, v_blk = inputs
+            logits = jnp.einsum(
+                "bqkgh,btkh->bqkgt", q_blk, k_blk,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                mask = q_pos[qi][:, None] >= kv_pos[ki][None, :]
+                logits = jnp.where(mask[None, :, None, None, :], logits, -1e30)
+            m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+            p = jnp.exp(logits - m_new[..., None])
+            corr = jnp.exp(m - m_new)
+            l = l * corr + jnp.sum(p, axis=-1)
+            pv = jnp.einsum(
+                "bqkgt,btkh->bqkgh", p.astype(v_blk.dtype), v_blk,
+                preferred_element_type=jnp.float32,
+            )
+            acc = acc * corr[..., None] + pv
+            return (acc, m_new, l), None
+
+        (acc, m, l), _ = jax.lax.scan(
+            body, (acc0, m0, l0),
+            (jnp.arange(nkv), jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0)),
+        )
+        return acc / jnp.maximum(l, 1e-30)[..., None]
+
+    out = jax.lax.map(
+        lambda args: per_qblock(*args),
+        (jnp.arange(nq), jnp.moveaxis(qb, 1, 0)),
+    )  # (nq, B, block_q, KVH, G, hd)
+    out = jnp.moveaxis(out, 0, 1).reshape(B, Sq, KVH, G, hd)
+    return out
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jax.Array:
+    """q (B, S, H, hd); k/v (B, T, KVH, hd) → (B, S, H, hd).
+
+    On TPU, dispatches to the Pallas kernel when the shapes are
+    tile-friendly; otherwise runs the XLA blockwise formulation.
+    """
+    B, S, H, hd = q.shape
+    KVH = k.shape[2]
+    if H % KVH != 0:
+        raise ValueError(f"n_heads {H} not divisible by n_kv_heads {KVH}")
+    G = H // KVH
+
+    # Trace-safe backend probe (q may be a tracer inside jit).
+    if jax.default_backend() in ("tpu", "axon"):
+        try:
+            from .pallas_attention import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal)
+        except (ImportError, NotImplementedError):
+            pass
+
+    qg = q.reshape(B, S, KVH, G, hd)
+    out = _blockwise_attention(
+        qg, k, v, causal=causal, block_q=block_q, block_kv=block_kv
+    )
+    return out.reshape(B, S, H, hd).astype(q.dtype)

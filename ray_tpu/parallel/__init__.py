@@ -1,0 +1,40 @@
+"""Parallelism layer: meshes, shardings, train steps, pipeline/sequence
+parallel schedules. See ray_tpu.parallel.mesh for the axis conventions."""
+
+from .mesh import (
+    AXIS_ORDER,
+    BATCH_AXES,
+    MeshConfig,
+    batch_sharding,
+    batch_spec,
+    dp_degree,
+    make_mesh,
+    mesh_axis_size,
+    single_device_mesh,
+)
+from .train_step import (
+    TrainState,
+    create_train_state,
+    default_optimizer,
+    make_eval_step,
+    make_train_step,
+    state_shardings,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "BATCH_AXES",
+    "MeshConfig",
+    "batch_sharding",
+    "batch_spec",
+    "dp_degree",
+    "make_mesh",
+    "mesh_axis_size",
+    "single_device_mesh",
+    "TrainState",
+    "create_train_state",
+    "default_optimizer",
+    "make_eval_step",
+    "make_train_step",
+    "state_shardings",
+]

@@ -1,0 +1,138 @@
+"""Device-mesh construction and axis conventions.
+
+The framework's parallelism surface is expressed as named axes over a
+`jax.sharding.Mesh` — the TPU-native replacement for the reference's
+process-group world (reference: python/ray/train/torch/config.py:66
+builds a torch.distributed NCCL group; python/ray/util/collective/
+collective.py:123 builds NCCL groups per device list). On TPU, the mesh
+IS the communicator: shardings annotated against these axes make XLA
+emit the collectives over ICI.
+
+Axis conventions (every component in the framework uses these names):
+
+- ``data``   — pure data parallelism (batch split; gradients psum).
+                Multi-slice/DCN-friendly: keep it the outermost axis.
+- ``fsdp``   — data parallelism with parameter sharding (ZeRO-3 /
+                fully-sharded): params are sharded on this axis and
+                all-gathered by XLA just-in-time; grads reduce-scatter.
+- ``model``  — tensor parallelism (Megatron-style sharded matmuls).
+- ``seq``    — sequence/context parallelism (ring attention,
+                see ray_tpu.ops.ring_attention).
+- ``expert`` — expert parallelism for MoE layers.
+
+A mesh does not need all axes: absent axes default to size 1.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis order — outermost (slowest-varying, DCN-adjacent) first.
+AXIS_ORDER = ("data", "fsdp", "expert", "seq", "model")
+
+# Batch dim of activations is sharded over every data-like axis.
+BATCH_AXES = ("data", "fsdp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape. Axes not listed get size 1 and are dropped.
+
+    ``auto_axis`` names the axis that absorbs any unassigned devices
+    (device_count // product(explicit sizes)).
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    model: int = 1
+    auto_axis: str = "fsdp"
+
+    def __post_init__(self):
+        if self.auto_axis not in AXIS_ORDER:
+            raise ValueError(
+                f"auto_axis {self.auto_axis!r} not one of {AXIS_ORDER}"
+            )
+
+    def sizes(self) -> Dict[str, int]:
+        return {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "expert": self.expert,
+            "seq": self.seq,
+            "model": self.model,
+        }
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all local devices).
+
+    Accepts either a MeshConfig or axis sizes as kwargs:
+    ``make_mesh(fsdp=4, model=2)``. If the explicit sizes don't consume
+    every device, the remainder goes to ``auto_axis`` (default fsdp) —
+    so ``make_mesh()`` on an 8-chip host is an 8-way fsdp mesh.
+
+    All axes in AXIS_ORDER are always present in the mesh (size-1 axes
+    included) so PartitionSpecs naming any canonical axis always resolve.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes)
+    elif axis_sizes:
+        raise ValueError("pass either a MeshConfig or axis sizes, not both")
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+
+    sizes = config.sizes()
+    explicit = math.prod(sizes.values())
+    if n % explicit != 0:
+        raise ValueError(
+            f"{n} devices not divisible by requested mesh {sizes} (={explicit})"
+        )
+    remainder = n // explicit
+    if remainder > 1:
+        sizes[config.auto_axis] *= remainder
+
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    """A 1-device mesh carrying all canonical axes at size 1 — lets
+    sharded code paths run unchanged on one chip."""
+    dev = device if device is not None else jax.devices()[0]
+    return make_mesh(MeshConfig(), devices=[dev])
+
+
+def batch_spec(extra_dims: int = 1) -> P:
+    """PartitionSpec for an activation batch: dim0 over (data, fsdp),
+    ``extra_dims`` trailing unsharded dims."""
+    return P(BATCH_AXES, *([None] * extra_dims))
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(extra_dims))
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)
+
+
+def dp_degree(mesh: Mesh) -> int:
+    """Total data-parallel degree (batch split factor)."""
+    return mesh_axis_size(mesh, "data") * mesh_axis_size(mesh, "fsdp")

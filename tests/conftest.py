@@ -12,11 +12,18 @@ import os
 
 # Must be set before jax is imported anywhere in the test process.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault(
-    "XLA_FLAGS",
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
-)
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
 os.environ.setdefault("RAY_TPU_NUM_TPUS", "0")
+
+import jax
+
+# The environment's PJRT plugin (axon) force-selects itself via
+# jax.config at interpreter start, overriding JAX_PLATFORMS env; pin
+# the config back to cpu so tests run on the virtual 8-device mesh.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
