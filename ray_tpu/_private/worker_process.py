@@ -154,6 +154,12 @@ class WorkerRuntime:
                     },
                 )
                 os._exit(0)
+            elif method_name == "__ray_call__":
+                # run an arbitrary callable against the actor instance
+                # (reference: ray's ActorHandle.__ray_call__)
+                args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
+                fn, rest = args[0], args[1:]
+                result = fn(self.actor_instance, *rest, **kwargs)
             else:
                 method = getattr(self.actor_instance, method_name)
                 args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])

@@ -57,7 +57,7 @@ class ActorHandle:
         object.__setattr__(self, "_ready_ref", ready_ref)
 
     def __getattr__(self, name: str) -> ActorMethod:
-        if name.startswith("__") and name.endswith("__"):
+        if name.startswith("__") and name.endswith("__") and name != "__ray_call__":
             raise AttributeError(name)
         return ActorMethod(self, name)
 

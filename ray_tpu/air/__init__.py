@@ -1,0 +1,23 @@
+"""ray_tpu.air — shared configs and results for Train/Tune.
+
+Parity: python/ray/air/ in the reference (config.py:103 ScalingConfig,
+:398 FailureConfig, :448 CheckpointConfig, :597 RunConfig; Result in
+air/result.py). TPU-native addition: ScalingConfig speaks chips and
+slice topologies, not GPUs.
+"""
+
+from .config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .result import Result
+
+__all__ = [
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "Result",
+]

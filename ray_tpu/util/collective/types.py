@@ -1,0 +1,98 @@
+"""Collective types: reduce ops, backends, group descriptors.
+
+Parity: python/ray/util/collective/types.py in the reference (ReduceOp,
+Backend validation, *Options dataclasses). TPU-native difference: the
+primary backend is "xla" — collectives compile to XLA programs over a
+device mesh — rather than NCCL; "store" is the CPU/cross-process
+fallback (the reference's gloo role).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+class ReduceOp(enum.Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    AVERAGE = 4
+
+
+class Backend:
+    """Validated backend name (reference: types.py Backend class).
+
+    - ``XLA``: in-process device mesh; ops are cached shape-specialized
+      jitted programs; collectives ride ICI on real hardware.
+    - ``STORE``: cross-process eager collectives rendezvoused through a
+      named coordinator actor (the reference's gloo/NCCLUniqueIDStore
+      pattern, nccl_collective_group.py:29-92).
+    """
+
+    XLA = "xla"
+    STORE = "store"
+    NCCL = "nccl"  # rejected with a helpful error (no NVIDIA on TPU)
+    GLOO = "gloo"  # alias of STORE
+
+    def __new__(cls, name: str):
+        backend = name.lower() if isinstance(name, str) else name
+        if backend == cls.GLOO:
+            backend = cls.STORE
+        if backend == cls.NCCL:
+            raise ValueError(
+                "NCCL is a GPU backend; on TPU use backend='xla' (ICI mesh) "
+                "or backend='store' (CPU/cross-process)."
+            )
+        if backend not in (cls.XLA, cls.STORE):
+            raise ValueError(f"Unsupported collective backend: {name!r}")
+        return backend
+
+
+@dataclass
+class AllReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduceOp: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000

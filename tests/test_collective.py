@@ -1,0 +1,188 @@
+"""Collective library tests.
+
+Pattern from the reference: collective logic tested without real
+accelerator fabric (python/ray/experimental/collective/conftest.py
+AbstractNcclGroup fake; channel/cpu_communicator.py). Here the xla
+backend runs on the 8-device virtual CPU mesh and the store backend on
+real multi-process workers.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+from ray_tpu.util.collective.types import ReduceOp
+
+
+@pytest.fixture
+def xla_group():
+    name = "xla_test"
+    group = col.init_collective_group(4, 0, backend="xla", group_name=name)
+    yield group
+    col.destroy_collective_group(name)
+
+
+class TestXlaGroup:
+    def test_allreduce_sum(self, xla_group):
+        tensors = [np.full((4, 3), float(i)) for i in range(4)]
+        out = xla_group.allreduce(tensors)
+        for t in out:
+            np.testing.assert_allclose(np.asarray(t), np.full((4, 3), 6.0))
+
+    def test_allreduce_ops(self, xla_group):
+        tensors = [np.full((2,), float(i + 1)) for i in range(4)]
+        from ray_tpu.util.collective.types import AllReduceOptions
+
+        out = xla_group.allreduce(tensors, AllReduceOptions(reduceOp=ReduceOp.MAX))
+        np.testing.assert_allclose(np.asarray(out[0]), [4.0, 4.0])
+        out = xla_group.allreduce(tensors, AllReduceOptions(reduceOp=ReduceOp.MIN))
+        np.testing.assert_allclose(np.asarray(out[0]), [1.0, 1.0])
+        out = xla_group.allreduce(tensors, AllReduceOptions(reduceOp=ReduceOp.AVERAGE))
+        np.testing.assert_allclose(np.asarray(out[0]), [2.5, 2.5])
+        out = xla_group.allreduce(tensors, AllReduceOptions(reduceOp=ReduceOp.PRODUCT))
+        np.testing.assert_allclose(np.asarray(out[0]), [24.0, 24.0])
+
+    def test_broadcast(self, xla_group):
+        tensors = [np.full((3,), float(i)) for i in range(4)]
+        from ray_tpu.util.collective.types import BroadcastOptions
+
+        out = xla_group.broadcast(tensors, BroadcastOptions(root_rank=2))
+        for t in out:
+            np.testing.assert_allclose(np.asarray(t), [2.0, 2.0, 2.0])
+
+    def test_reduce(self, xla_group):
+        from ray_tpu.util.collective.types import ReduceOptions
+
+        tensors = [np.full((2,), 1.0) for _ in range(4)]
+        out = xla_group.reduce(tensors, ReduceOptions(root_rank=1))
+        np.testing.assert_allclose(np.asarray(out[1]), [4.0, 4.0])
+        np.testing.assert_allclose(np.asarray(out[0]), [1.0, 1.0])
+
+    def test_allgather(self, xla_group):
+        tensors = [np.full((2,), float(i)) for i in range(4)]
+        out = xla_group.allgather(tensors)
+        expect = np.stack([np.full((2,), float(i)) for i in range(4)])
+        for t in out:
+            np.testing.assert_allclose(np.asarray(t), expect)
+
+    def test_reducescatter(self, xla_group):
+        # each rank holds the full [8] vector; rank i gets reduced chunk i
+        tensors = [np.arange(8, dtype=np.float32) for _ in range(4)]
+        out = xla_group.reducescatter(tensors)
+        for i, t in enumerate(out):
+            np.testing.assert_allclose(
+                np.asarray(t), np.arange(8, dtype=np.float32)[2 * i : 2 * i + 2] * 4
+            )
+
+    def test_program_cache_reused(self, xla_group):
+        tensors = [np.ones((2, 2)) for _ in range(4)]
+        xla_group.allreduce(tensors)
+        n = len(xla_group._programs)
+        xla_group.allreduce([np.full((2, 2), 2.0) for _ in range(4)])
+        assert len(xla_group._programs) == n  # same shape -> cached
+        xla_group.allreduce([np.ones((3,)) for _ in range(4)])
+        assert len(xla_group._programs) == n + 1
+
+    def test_barrier(self, xla_group):
+        xla_group.barrier()
+
+
+def _store_worker(rank, world, group_name, op):
+    from ray_tpu.util import collective as c
+    from ray_tpu.util.collective.types import (
+        RecvOptions,
+        SendOptions,
+    )
+
+    g = c.init_collective_group(world, rank, backend="store", group_name=group_name)
+    data = np.full((4,), float(rank + 1), dtype=np.float32)
+    try:
+        if op == "allreduce":
+            return g.allreduce(data)
+        if op == "allgather":
+            return g.allgather(data)
+        if op == "reducescatter":
+            return g.reducescatter(np.arange(4, dtype=np.float32))
+        if op == "broadcast":
+            from ray_tpu.util.collective.types import BroadcastOptions
+
+            return g.broadcast(data, BroadcastOptions(root_rank=1))
+        if op == "barrier":
+            g.barrier()
+            return rank
+        if op == "sendrecv":
+            if rank == 0:
+                g.send(np.array([42.0]), SendOptions(dst_rank=1))
+                return None
+            return g.recv(RecvOptions(src_rank=0))
+    finally:
+        c.destroy_collective_group(group_name)
+
+
+class TestStoreGroup:
+    def _run(self, op, name, world=2):
+        f = ray_tpu.remote(_store_worker)
+        refs = [f.remote(r, world, name, op) for r in range(world)]
+        return ray_tpu.get(refs)
+
+    def test_allreduce(self, ray_start_4_cpus):
+        out = self._run("allreduce", "sg_ar")
+        for t in out:
+            np.testing.assert_allclose(t, np.full((4,), 3.0))
+
+    def test_allgather(self, ray_start_4_cpus):
+        out = self._run("allgather", "sg_ag")
+        expect = np.stack([np.full((4,), 1.0), np.full((4,), 2.0)])
+        np.testing.assert_allclose(out[0], expect)
+        np.testing.assert_allclose(out[1], expect)
+
+    def test_reducescatter(self, ray_start_4_cpus):
+        out = self._run("reducescatter", "sg_rs")
+        np.testing.assert_allclose(out[0], [0.0, 2.0])
+        np.testing.assert_allclose(out[1], [4.0, 6.0])
+
+    def test_broadcast(self, ray_start_4_cpus):
+        out = self._run("broadcast", "sg_bc")
+        for t in out:
+            np.testing.assert_allclose(t, np.full((4,), 2.0))
+
+    def test_barrier(self, ray_start_4_cpus):
+        assert sorted(self._run("barrier", "sg_b")) == [0, 1]
+
+    def test_sendrecv(self, ray_start_4_cpus):
+        out = self._run("sendrecv", "sg_p2p")
+        np.testing.assert_allclose(out[1], [42.0])
+
+
+class TestModuleAPI:
+    def test_module_level_functions(self):
+        col.init_collective_group(2, 0, backend="xla", group_name="mod_api")
+        try:
+            assert col.is_group_initialized("mod_api")
+            assert col.get_rank("mod_api") == 0
+            assert col.get_collective_group_size("mod_api") == 2
+            out = col.allreduce([np.ones(2), np.ones(2)], group_name="mod_api")
+            np.testing.assert_allclose(np.asarray(out[0]), [2.0, 2.0])
+        finally:
+            col.destroy_collective_group("mod_api")
+        assert not col.is_group_initialized("mod_api")
+
+    def test_nccl_rejected(self):
+        with pytest.raises(ValueError, match="NCCL is a GPU backend"):
+            col.init_collective_group(2, 0, backend="nccl", group_name="x")
+
+    def test_declarative_create(self, ray_start_4_cpus):
+        class W:
+            def reduce_val(self, group_name):
+                from ray_tpu.util import collective as c
+
+                return c.allreduce(np.array([1.0]), group_name=group_name)
+
+        WA = ray_tpu.remote(W)
+        actors = [WA.remote() for _ in range(2)]
+        col.create_collective_group(
+            actors, 2, [0, 1], backend="store", group_name="decl"
+        )
+        out = ray_tpu.get([a.reduce_val.remote("decl") for a in actors])
+        np.testing.assert_allclose(out[0], [2.0])
