@@ -57,10 +57,10 @@ class ScalingConfig:
 
     @property
     def total_resources(self) -> Dict[str, float]:
-        out: Dict[str, float] = {}
-        for b in [self._resources_per_worker_not_none()] * self.num_workers:
-            for k, v in b.items():
-                out[k] = out.get(k, 0.0) + v
+        out: Dict[str, float] = dict(self.trainer_resources or {})
+        per_worker = self._resources_per_worker_not_none()
+        for k, v in per_worker.items():
+            out[k] = out.get(k, 0.0) + v * self.num_workers
         return out
 
 

@@ -98,20 +98,23 @@ class Checkpoint:
         return cls(d)
 
     def to_jax(self, target: Any = None, shardings: Any = None) -> Any:
-        """Restore the pytree; ``target``/``shardings`` reproduce the
-        original structure and (optionally) device placement."""
+        """Restore the pytree saved by ``from_jax``.
+
+        ``shardings``: optional pytree of ``jax.sharding.Sharding``
+        matching the restored structure — each restored array is placed
+        onto its sharding (so a fresh mesh after a gang restart gets
+        correctly-sharded state). ``target`` is accepted for structural
+        parity with orbax's restore-into API; structure restoration is
+        by-name so it is not required.
+        """
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
-        item = os.path.join(self.path, "jax_state")
-        if target is not None:
-            try:
-                import jax
+        restored = ckptr.restore(os.path.join(self.path, "jax_state"))
+        if shardings is not None:
+            import jax
 
-                args = ocp.args.PyTreeRestore(
-                    item=target,
-                )
-                return ckptr.restore(item, args)
-            except Exception:
-                return ckptr.restore(item)
-        return ckptr.restore(item)
+            restored = jax.tree.map(
+                lambda x, s: jax.device_put(x, s), restored, shardings
+            )
+        return restored

@@ -61,10 +61,13 @@ class _Coordinator:
         self.world_size = world_size
         # (op_seq) -> {rank: payload}
         self.boxes: Dict[Tuple, Dict[int, Any]] = {}
-        # completed results cache: key -> (result, picked_up_count)
-        self.results: Dict[Tuple, Tuple[Any, int]] = {}
+        # key -> how many ranks already pulled the completed box
+        self.pickups: Dict[Tuple, int] = {}
         # p2p mailboxes: (src, dst, tag) -> payload
         self.mail: Dict[Tuple, Any] = {}
+
+    def world(self) -> int:
+        return self.world_size
 
     def post(self, key: Tuple, rank: int, payload: Any) -> None:
         self.boxes.setdefault(key, {})[rank] = payload
@@ -76,12 +79,12 @@ class _Coordinator:
             return None
         # keep until all ranks pulled, then GC
         result = dict(box)
-        picked = self.results.get(key, (None, 0))[1] + 1
+        picked = self.pickups.get(key, 0) + 1
         if picked >= self.world_size:
             self.boxes.pop(key, None)
-            self.results.pop(key, None)
+            self.pickups.pop(key, None)
         else:
-            self.results[key] = (None, picked)
+            self.pickups[key] = picked
         return result
 
     def p2p_send(self, src: int, dst: int, tag: int, payload: Any) -> None:
@@ -99,7 +102,10 @@ class StoreGroup(BaseGroup):
         super().__init__(world_size, rank, group_name)
         import ray_tpu
 
-        actor_name = f"__collective_{group_name}"
+        # world_size is part of the rendezvous name so a later group that
+        # reuses the name with a different size can never adopt a stale
+        # coordinator (whose collect() would fire at the old world count).
+        actor_name = f"__collective_{group_name}_w{world_size}"
         coord_cls = ray_tpu.remote(_Coordinator)
         try:
             self._coord = ray_tpu.get_actor(actor_name)

@@ -23,10 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from ..types import (
     AllGatherOptions,
@@ -224,6 +221,15 @@ class XlaGroup(BaseGroup):
     ):
         red_op = opts.reduceOp
         world = self._world_size
+        # per-rank input is the full tensor; shape check before tracing
+        if isinstance(tensors, (list, tuple)):
+            dim0 = jnp.shape(tensors[0])[0]
+        else:
+            dim0 = tensors.shape[1]  # stacked [world, m, ...]
+        if dim0 % world != 0:
+            raise ValueError(
+                f"reducescatter dim0 {dim0} not divisible by world_size {world}"
+            )
 
         def body(x):  # x: [1, world*k...] per rank holds full input
             y = jax.lax.psum(x, _AXIS) if red_op in (ReduceOp.SUM, ReduceOp.AVERAGE) else _reduce_fn(red_op)(x)
