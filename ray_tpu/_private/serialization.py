@@ -6,9 +6,12 @@ python/ray/_private/serialization.py:122 SerializationContext):
 - *functions/closures* go through cloudpickle (pickle-by-value), exported
   once per function and cached by the receiving worker (reference:
   python/ray/_private/function_manager.py:58).
-- *data* goes through stdlib pickle protocol 5 with out-of-band buffers
-  so numpy/jax arrays are not copied into the pickle stream; falls back
-  to cloudpickle when the payload contains closures.
+- *data* goes through cloudpickle at protocol 5 with out-of-band buffers
+  so numpy/jax arrays are not copied into the pickle stream. cloudpickle
+  (not stdlib pickle) everywhere: importable objects serialize by
+  reference at plain-pickle speed, while __main__-level functions and
+  closures — which stdlib pickle would emit by reference and the worker
+  could never import — serialize by value.
 
 The wire format is a (header_bytes, [buffer, ...]) pair; buffers can be
 placed into shared memory by the object store for zero-copy cross-process
@@ -26,15 +29,17 @@ PICKLE5 = 5
 
 
 def dumps_oob(obj: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
-    """Serialize with out-of-band buffers. Returns (header, buffers)."""
+    """Serialize with out-of-band buffers. Returns (header, buffers).
+
+    Always cloudpickle: plain pickle would serialize ``__main__``-level
+    functions BY REFERENCE (module+qualname) — succeeding here and
+    failing only at load time inside the worker, where ``__main__`` is
+    the worker binary. cloudpickle pickles importable objects by
+    reference (plain-pickle speed) and main/closure objects by value.
+    """
     buffers: List[pickle.PickleBuffer] = []
-    try:
-        header = pickle.dumps(obj, protocol=PICKLE5, buffer_callback=buffers.append)
-        return b"P" + header, buffers
-    except Exception:
-        buffers.clear()
-        header = cloudpickle.dumps(obj, protocol=PICKLE5, buffer_callback=buffers.append)
-        return b"C" + header, buffers
+    header = cloudpickle.dumps(obj, protocol=PICKLE5, buffer_callback=buffers.append)
+    return b"C" + header, buffers
 
 
 def loads_oob(header: bytes, buffers: List[Any]) -> Any:
@@ -51,11 +56,9 @@ def loads_function(blob: bytes) -> Any:
 
 
 def dumps_inline(obj: Any) -> bytes:
-    """One-shot serialize (no out-of-band buffers) for small control data."""
-    try:
-        return b"P" + pickle.dumps(obj, protocol=PICKLE5)
-    except Exception:
-        return b"C" + cloudpickle.dumps(obj)
+    """One-shot serialize (no out-of-band buffers) for small control
+    data. cloudpickle for the same by-reference trap as dumps_oob."""
+    return b"C" + cloudpickle.dumps(obj, protocol=PICKLE5)
 
 
 def loads_inline(blob: bytes) -> Any:

@@ -1,0 +1,46 @@
+"""ray_tpu.train — distributed training on TPU gangs.
+
+Parity: python/ray/train/ (v2 controller shape). Public surface:
+JaxTrainer / DataParallelTrainer, report/get_context/get_checkpoint/
+get_dataset_shard, Checkpoint, ScalingConfig/RunConfig/FailureConfig/
+CheckpointConfig, Result, Backend/BackendConfig/JaxConfig.
+"""
+
+from ..air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ..air.result import Result
+from ._checkpoint import Checkpoint
+from .backend import Backend, BackendConfig, JaxConfig
+from .data_parallel_trainer import DataParallelTrainer, TrainingFailedError
+from .jax_trainer import JaxTrainer
+from .session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainContext",
+    "TrainingFailedError",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

@@ -100,20 +100,30 @@ class Checkpoint:
     def to_jax(self, target: Any = None, shardings: Any = None) -> Any:
         """Restore the pytree saved by ``from_jax``.
 
-        ``shardings``: optional pytree of ``jax.sharding.Sharding``
-        matching the restored structure — each restored array is placed
-        onto its sharding (so a fresh mesh after a gang restart gets
-        correctly-sharded state). ``target`` is accepted for structural
-        parity with orbax's restore-into API; structure restoration is
-        by-name so it is not required.
+        ``target``: optional pytree template — the restored values are
+        re-assembled into its exact structure (dataclasses/TrainState
+        included). ``shardings``: optional pytree of
+        ``jax.sharding.Sharding`` with the same structure — each
+        restored array is placed onto its sharding, so a fresh mesh
+        after a gang restart gets correctly-sharded state.
         """
+        import jax
         import orbax.checkpoint as ocp
 
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(os.path.join(self.path, "jax_state"))
+        if target is not None:
+            # orbax stores the tree as nested dicts; rebuild the caller's
+            # structure (leaf order is preserved by the save/restore pair)
+            leaves = jax.tree.leaves(restored)
+            treedef = jax.tree.structure(target)
+            if treedef.num_leaves != len(leaves):
+                raise ValueError(
+                    f"checkpoint has {len(leaves)} arrays but target "
+                    f"structure expects {treedef.num_leaves}"
+                )
+            restored = jax.tree.unflatten(treedef, leaves)
         if shardings is not None:
-            import jax
-
             restored = jax.tree.map(
                 lambda x, s: jax.device_put(x, s), restored, shardings
             )

@@ -1,0 +1,103 @@
+"""Backend plugin: per-framework worker-group setup hooks.
+
+Parity: python/ray/train/backend.py:16,32 (Backend/BackendConfig with
+on_start/on_training_start/on_shutdown). The reference's _TorchBackend
+(train/torch/config.py:36,153) picks worker-0's addr/port and calls
+dist.init_process_group on every worker; the TPU-native JaxConfig does
+the same handshake with `jax.distributed.initialize` — rank 0 is the
+coordinator — then every worker builds the same `jax.sharding.Mesh`
+over the gang's chips, and XLA collectives ride ICI from there.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass
+class BackendConfig:
+    """Declarative config; backend_cls() yields the imperative hooks."""
+
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Hooks run by the controller against the worker group. Each hook
+    receives the WorkerGroup and the BackendConfig."""
+
+    share_env_vars: bool = False
+
+    def on_start(self, worker_group, backend_config: "BackendConfig") -> None:
+        pass
+
+    def on_training_start(self, worker_group, backend_config: "BackendConfig") -> None:
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: "BackendConfig") -> None:
+        pass
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """JAX/TPU backend.
+
+    coordinator_port: for multi-host pods, the jax.distributed
+    coordinator (rank 0's host) binds here. mesh_shape: axis sizes for
+    the gang's device mesh, e.g. {"data": 2, "model": 4}; defaults to
+    pure data-parallel over all chips. enable_distributed: off on a
+    single host (one process already owns every local chip — JAX's
+    single-controller model needs no rendezvous).
+    """
+
+    coordinator_port: int = 8476
+    mesh_shape: Optional[Dict[str, int]] = None
+    enable_distributed: Optional[bool] = None  # None = auto (world_size > 1 hosts)
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _jax_worker_setup(
+    worker, coordinator_addr: str, num_processes: int, process_id: int
+):
+    """Runs inside each TrainWorker actor: the jax.distributed handshake
+    (the _TorchBackend init_process_group analogue)."""
+    import jax
+
+    if num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_addr,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return True
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxConfig) -> None:
+        n = len(worker_group.workers)
+        distributed = backend_config.enable_distributed
+        if distributed is None:
+            # distinct hostnames => multi-host gang => rendezvous needed
+            hosts = {w.metadata["hostname"] for w in worker_group.workers}
+            distributed = len(hosts) > 1
+        if not distributed:
+            return
+        import ray_tpu
+
+        rank0 = worker_group.workers[0]
+        addr = f"{rank0.metadata['hostname']}:{backend_config.coordinator_port}"
+        refs = [
+            w.actor.run_backend_hook.remote(
+                _jax_worker_setup, addr, n, w.rank
+            )
+            for w in worker_group.workers
+        ]
+        ray_tpu.get(refs)
+
+    def on_shutdown(self, worker_group, backend_config: JaxConfig) -> None:
+        pass

@@ -1,0 +1,45 @@
+"""JaxTrainer: the flagship trainer (reference analogue: TorchTrainer,
+python/ray/train/torch/torch_trainer.py — but jit/pjit-first).
+
+The train_loop_per_worker runs inside each gang worker with:
+- ``ray_tpu.train.get_context()`` — rank/world info
+- ``ray_tpu.train.report(metrics, checkpoint=...)`` — metrics + ckpt
+- ``ray_tpu.train.get_checkpoint()`` — resume point after restarts
+- ``ray_tpu.parallel.make_mesh(...)`` — the worker's device mesh; on a
+  TPU host the single worker owns all local chips, so data/fsdp/model
+  shardings compile to ICI collectives with zero framework overhead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ..air.config import RunConfig, ScalingConfig
+from ._checkpoint import Checkpoint
+from .backend import JaxConfig
+from .data_parallel_trainer import DataParallelTrainer
+
+
+class JaxTrainer(DataParallelTrainer):
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        jax_config: Optional[JaxConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=jax_config or JaxConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+            metadata=metadata,
+        )

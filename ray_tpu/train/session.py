@@ -69,13 +69,17 @@ class _TrainSession:
         storage_dir: str,
         latest_checkpoint: Optional[Checkpoint] = None,
         dataset_shards: Optional[Dict[str, Any]] = None,
+        start_iteration: int = 0,
     ):
         self.context = context
         self.storage_dir = storage_dir
         self.result_queue: "queue.Queue" = queue.Queue()
         self.latest_checkpoint = latest_checkpoint
         self.dataset_shards = dataset_shards or {}
-        self.iteration = 0
+        # Continues across gang restarts (controller passes the next
+        # global iteration) so checkpoint_NNNNNN dirs never collide with
+        # a previous attempt's.
+        self.iteration = start_iteration
         self.stop_requested = threading.Event()
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):

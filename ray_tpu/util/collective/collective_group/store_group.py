@@ -65,9 +65,19 @@ class _Coordinator:
         self.pickups: Dict[Tuple, int] = {}
         # p2p mailboxes: (src, dst, tag) -> payload
         self.mail: Dict[Tuple, Any] = {}
+        # rank -> number of times it joined (group incarnations)
+        self.joins: Dict[int, int] = {}
 
-    def world(self) -> int:
-        return self.world_size
+    def join(self, rank: int) -> int:
+        """Rank's incarnation number (1 on first join, 2 after the whole
+        group is re-created, ...). Incarnations are folded into op keys,
+        so a re-created group can never collect a stale box left by a
+        previous incarnation that timed out or died mid-op. (If only ONE
+        member re-joins a live group, its incarnation diverges and its
+        ops time out — loud failure instead of silent corruption;
+        rebuild the whole group in that case.)"""
+        self.joins[rank] = self.joins.get(rank, 0) + 1
+        return self.joins[rank]
 
     def post(self, key: Tuple, rank: int, payload: Any) -> None:
         self.boxes.setdefault(key, {})[rank] = payload
@@ -121,6 +131,7 @@ class StoreGroup(BaseGroup):
         self._send_tags: Dict[int, int] = {}  # dst -> next tag
         self._recv_tags: Dict[int, int] = {}  # src -> next tag
         self._ray = ray_tpu
+        self._inc = ray_tpu.get(self._coord.join.remote(rank))
 
     @property
     def backend(self) -> str:
@@ -139,7 +150,7 @@ class StoreGroup(BaseGroup):
         return np.asarray(t)
 
     def _exchange(self, op_name: str, payload, timeout_ms: int) -> Dict[int, Any]:
-        key = (op_name, self._seq)
+        key = (op_name, self._inc, self._seq)
         self._seq += 1
         self._ray.get(self._coord.post.remote(key, self._rank, payload))
         deadline = time.monotonic() + timeout_ms / 1000.0
@@ -202,7 +213,7 @@ class StoreGroup(BaseGroup):
         self._send_tags[opts.dst_rank] = tag + 1
         self._ray.get(
             self._coord.p2p_send.remote(
-                self._rank, opts.dst_rank, tag, self._to_np(tensor)
+                self._rank, opts.dst_rank, (self._inc, tag), self._to_np(tensor)
             )
         )
 
@@ -212,7 +223,9 @@ class StoreGroup(BaseGroup):
         deadline = time.monotonic() + opts.timeout_ms / 1000.0
         while True:
             ok, payload = self._ray.get(
-                self._coord.p2p_recv.remote(opts.src_rank, self._rank, tag)
+                self._coord.p2p_recv.remote(
+                    opts.src_rank, self._rank, (self._inc, tag)
+                )
             )
             if ok:
                 return payload
