@@ -1,0 +1,38 @@
+"""ray_tpu.data — distributed datasets for TPU pipelines.
+
+Parity: python/ray/data/ in the reference (Dataset, read_api,
+aggregate, ActorPoolStrategy, DataContext). Columnar-numpy blocks,
+lazy logical plans, a streaming task/actor-pool executor, and HBM
+batch staging. See dataset.py for the surface.
+"""
+
+from .aggregate import AbsMax, AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .block import Block, BlockAccessor, BlockMetadata
+from .context import DataContext
+from .dataset import (
+    ActorPoolStrategy,
+    Dataset,
+    GroupedData,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+from .datasource import Datasource, ReadTask
+
+__all__ = [
+    "AbsMax", "ActorPoolStrategy", "AggregateFn", "Block", "BlockAccessor",
+    "BlockMetadata", "Count", "DataContext", "Dataset", "Datasource",
+    "GroupedData", "Max", "Mean", "Min", "ReadTask", "Std", "Sum",
+    "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
+    "read_binary_files", "read_csv", "read_datasource", "read_json",
+    "read_numpy", "read_parquet", "read_text",
+]

@@ -1,0 +1,36 @@
+"""DataContext: per-driver execution configuration singleton.
+
+Parity: python/ray/data/context.py (DataContext.get_current, target
+block sizes, execution caps, use_push_based_shuffle :255).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import ClassVar, Optional
+
+
+@dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # max concurrently-running block tasks per operator (the streaming
+    # executor's admission cap; reference analogue: ResourceManager +
+    # concurrency-cap backpressure policy)
+    max_tasks_in_flight: int = 8
+    read_op_min_num_blocks: int = 8
+    use_push_based_shuffle: bool = True
+    # stage into device memory in iter_batches when a device is requested
+    prefetch_batches: int = 2
+    eager_free: bool = True
+
+    _lock: ClassVar[threading.Lock] = threading.Lock()
+    _current: ClassVar[Optional["DataContext"]] = None
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        with DataContext._lock:
+            if DataContext._current is None:
+                DataContext._current = DataContext()
+            return DataContext._current

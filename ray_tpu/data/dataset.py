@@ -1,0 +1,533 @@
+"""Dataset: the lazy distributed data API.
+
+Parity: python/ray/data/dataset.py (6,080 lines in the reference; the
+surface here covers the operations its users reach for: map/map_batches
+/filter/flat_map, shuffles/sort/groupby, consumption, splits) +
+read_api.py. Everything is lazy: transforms append logical ops;
+consumption lowers through build_stages and runs on the streaming
+executor (see _internal/executor.py).
+
+TPU-native: ``iter_batches(device_put=...)`` stages columnar numpy
+batches straight into HBM with double-buffering — the `num_tpus`
+actor-pool stage plus this iterator are the reference's GPU
+batch-inference path (§3.5 step 4) re-done for chips.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from .block import Block, BlockAccessor
+from .context import DataContext
+from ._internal import plan as L
+from ._internal.executor import StreamingExecutor, build_stages
+
+
+class ActorPoolStrategy:
+    """Parity: ray.data.ActorPoolStrategy — pin UDFs to a pool of
+    actors (stateful / device-holding UDFs)."""
+
+    def __init__(self, size: Optional[int] = None, min_size: int = 1, max_size: Optional[int] = None):
+        self.size = size
+        self.min_size = size or min_size
+        self.max_size = size or max_size or self.min_size
+
+
+class Dataset:
+    def __init__(self, logical: L.LogicalPlan):
+        self._logical = logical
+        self._materialized: Optional[List[Any]] = None  # block refs
+
+    # ------------------------------------------------------ transforms
+    def _append(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(self._logical.with_op(op))
+
+    def map(self, fn: Callable, **opts) -> "Dataset":
+        return self._append(L.MapRows(fn=fn, **_map_opts(opts)))
+
+    def filter(self, fn: Callable, **opts) -> "Dataset":
+        return self._append(L.Filter(fn=fn, **_map_opts(opts)))
+
+    def flat_map(self, fn: Callable, **opts) -> "Dataset":
+        return self._append(L.FlatMap(fn=fn, **_map_opts(opts)))
+
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_constructor_args: Tuple = (),
+        fn_constructor_kwargs: Optional[dict] = None,
+        num_tpus: Optional[float] = None,
+        num_cpus: Optional[float] = None,
+        num_gpus: Optional[float] = None,
+        concurrency: Optional[Union[int, Tuple[int, int]]] = None,
+        zero_copy_batch: bool = False,
+        **_ignored,
+    ) -> "Dataset":
+        resources: Dict[str, float] = {}
+        if num_tpus:
+            resources["TPU"] = float(num_tpus)
+        if num_cpus:
+            resources["CPU"] = float(num_cpus)
+        if num_gpus:
+            resources["GPU"] = float(num_gpus)
+        if isinstance(fn, type) and compute is None:
+            # class UDFs imply actor compute (reference requires explicit
+            # concurrency; we default the pool to `concurrency` or 1)
+            compute = ActorPoolStrategy(
+                size=concurrency if isinstance(concurrency, int) else None
+            )
+        return self._append(
+            L.MapBatches(
+                fn=fn,
+                batch_size=batch_size,
+                batch_format=batch_format,
+                compute=compute,
+                fn_constructor_args=tuple(fn_constructor_args),
+                fn_constructor_kwargs=dict(fn_constructor_kwargs or {}),
+                resources=resources,
+                concurrency=concurrency,
+                zero_copy_batch=zero_copy_batch,
+            )
+        )
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(L.Limit(n=n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(L.Repartition(num_blocks=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None, num_blocks: Optional[int] = None) -> "Dataset":
+        return self._append(L.RandomShuffle(seed=seed, num_blocks=num_blocks))
+
+    def sort(self, key: Union[str, Callable], descending: bool = False) -> "Dataset":
+        return self._append(L.Sort(key=key, descending=descending))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
+        ds = self._append(L.Aggregate(key=None, aggs=list(aggs)))
+        rows = list(ds.iter_rows())
+        return {k: v for r in rows for k, v in r.items()}
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._append(L.Union(others=[o._logical.terminal for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._append(L.Zip(other=other._logical.terminal))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(batch):
+            batch[name] = np.asarray(fn(batch))
+            return batch
+
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self.map_batches(drop)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+
+        return self.map_batches(select)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def rename(batch):
+            return {mapping.get(k, k): v for k, v in batch.items()}
+
+        return self.map_batches(rename)
+
+    # ----------------------------------------------------- consumption
+    def _block_refs(self) -> Iterator[Any]:
+        if self._materialized is not None:
+            return iter(self._materialized)
+        return StreamingExecutor(build_stages(self._logical)).execute()
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result caches block refs (reference:
+        Dataset.materialize -> MaterializedDataset)."""
+        refs = list(self._block_refs())
+        ds = Dataset(L.LogicalPlan(L.FromBlocks(blocks=refs)))
+        ds._materialized = refs
+        return ds
+
+    def iter_internal_refs(self) -> Iterator[Any]:
+        return self._block_refs()
+
+    def iter_rows(self) -> Iterator[Any]:
+        import ray_tpu
+
+        for ref in self._block_refs():
+            yield from BlockAccessor.for_block(ray_tpu.get(ref)).iter_rows()
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: str = "numpy",
+        prefetch_batches: Optional[int] = None,
+        drop_last: bool = False,
+        device_put: Any = None,
+    ) -> Iterator[Any]:
+        """Stream batches; with ``device_put`` (a jax Device or Sharding)
+        batches are staged into device memory ahead of consumption —
+        the TPU HBM staging path."""
+        from .iterator import iter_batches as _iter
+
+        return _iter(
+            self._block_refs(),
+            batch_size=batch_size,
+            batch_format=batch_format,
+            prefetch_batches=(
+                prefetch_batches
+                if prefetch_batches is not None
+                else DataContext.get_current().prefetch_batches
+            ),
+            drop_last=drop_last,
+            device_put=device_put,
+        )
+
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        import torch
+
+        for batch in self.iter_batches(batch_format="numpy", **kwargs):
+            yield {k: torch.as_tensor(np.ascontiguousarray(v)) for k, v in batch.items()}
+
+    def take(self, n: int = 20) -> List[Any]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def take_batch(self, n: int = 20, batch_format: str = "numpy") -> Any:
+        import ray_tpu
+
+        blocks, have = [], 0
+        for ref in self._block_refs():
+            b = ray_tpu.get(ref)
+            blocks.append(b)
+            have += BlockAccessor.for_block(b).num_rows()
+            if have >= n:
+                break
+        merged = BlockAccessor.concat(blocks)
+        acc = BlockAccessor.for_block(merged)
+        return BlockAccessor.for_block(acc.slice(0, min(n, acc.num_rows()))).to_batch(batch_format)
+
+    def count(self) -> int:
+        import ray_tpu
+
+        count_remote = ray_tpu.remote(
+            lambda b: BlockAccessor.for_block(b).num_rows()
+        )
+        refs = [count_remote.remote(r) for r in self._block_refs()]
+        return int(sum(ray_tpu.get(refs)))
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        import ray_tpu
+
+        for ref in self._block_refs():
+            s = BlockAccessor.for_block(ray_tpu.get(ref)).schema()
+            if s:
+                return s
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        return list(s.keys()) if s else None
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self._block_refs())
+
+    def size_bytes(self) -> int:
+        import ray_tpu
+
+        return sum(
+            BlockAccessor.for_block(ray_tpu.get(r)).size_bytes()
+            for r in self._block_refs()
+        )
+
+    def to_pandas(self):
+        import ray_tpu
+
+        blocks = [ray_tpu.get(r) for r in self._block_refs()]
+        merged = BlockAccessor.concat(blocks)
+        return BlockAccessor.for_block(merged).to_pandas()
+
+    def to_numpy_refs(self) -> List[Any]:
+        return list(self._block_refs())
+
+    # ------------------------------------------------------------ splits
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Materializing split into n datasets (reference: Dataset.split)."""
+        import ray_tpu
+
+        refs = list(self._block_refs())
+        rows = [
+            (r, BlockAccessor.for_block(ray_tpu.get(r)).num_rows()) for r in refs
+        ]
+        total = sum(c for _, c in rows)
+        per = total // n
+        out: List[Dataset] = []
+        it = iter(rows)
+        carry: List[Tuple[Any, int]] = list(rows)
+        # simple greedy contiguous partition by row count
+        targets = [per + (1 if i < total % n else 0) for i in builtins.range(n)]
+        if equal:
+            targets = [per] * n
+        idx = 0
+        for t in targets:
+            blocks: List[Any] = []
+            need = t
+            while need > 0 and idx < len(carry):
+                ref, cnt = carry[idx]
+                if cnt <= need:
+                    blocks.append(ref)
+                    need -= cnt
+                    idx += 1
+                else:
+                    b = ray_tpu.get(ref)
+                    acc = BlockAccessor.for_block(b)
+                    blocks.append(ray_tpu.put(acc.slice(0, need)))
+                    carry[idx] = (ray_tpu.put(acc.slice(need, cnt)), cnt - need)
+                    need = 0
+            ds = Dataset(L.LogicalPlan(L.FromBlocks(blocks=blocks)))
+            ds._materialized = blocks
+            out.append(ds)
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = False, locality_hints=None) -> List["Dataset"]:
+        """Reference's streaming_split returns coordinated iterators; on
+        the single-host runtime a materializing split is equivalent."""
+        return self.split(n, equal=equal)
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        total = ds.count()
+        n_test = int(total * test_size) if isinstance(test_size, float) else test_size
+        mat = ds.materialize()
+        rows = mat.take_all()
+        train, test = rows[: total - n_test], rows[total - n_test :]
+        return from_items(train), from_items(test)
+
+    # ------------------------------------------------------------ write
+    def write_parquet(self, path: str) -> None:
+        import pyarrow.parquet as pq
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        import ray_tpu
+
+        for i, ref in enumerate(self._block_refs()):
+            table = BlockAccessor.for_block(ray_tpu.get(ref)).to_arrow()
+            pq.write_table(table, f"{path}/part-{i:05d}.parquet")
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        import ray_tpu
+
+        for i, ref in enumerate(self._block_refs()):
+            df = BlockAccessor.for_block(ray_tpu.get(ref)).to_pandas()
+            df.to_csv(f"{path}/part-{i:05d}.csv", index=False)
+
+    def write_json(self, path: str) -> None:
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        import ray_tpu
+
+        for i, ref in enumerate(self._block_refs()):
+            rows = list(BlockAccessor.for_block(ray_tpu.get(ref)).iter_rows())
+            with open(f"{path}/part-{i:05d}.json", "w") as f:
+                for r in rows:
+                    f.write(json.dumps({k: _json_safe(v) for k, v in r.items()}) + "\n")
+
+    # ------------------------------------------------------------ misc
+    def stats(self) -> str:
+        ops = [op.name for op in self._logical.ops()]
+        return f"Dataset(plan={' -> '.join(ops)})"
+
+    def __repr__(self):
+        return self.stats()
+
+
+def _json_safe(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    return v
+
+
+def _map_opts(opts: dict) -> dict:
+    resources = {}
+    if opts.get("num_tpus"):
+        resources["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_cpus"):
+        resources["CPU"] = float(opts["num_cpus"])
+    out = {"resources": resources}
+    if opts.get("compute"):
+        out["compute"] = opts["compute"]
+    if opts.get("concurrency") is not None:
+        out["concurrency"] = opts["concurrency"]
+    return out
+
+
+class GroupedData:
+    """Parity: ray.data.grouped_data.GroupedData."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        return self._ds._append(L.Aggregate(key=self._key, aggs=list(aggs)))
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn: Callable, *, batch_format: str = "numpy") -> Dataset:
+        key = self._key
+
+        def apply(batch):
+            acc = BlockAccessor.for_block(BlockAccessor.batch_to_block(batch))
+            block = acc.block
+            if not isinstance(block, dict):
+                raise ValueError("map_groups requires columnar data")
+            uniq, inverse = np.unique(block[key], return_inverse=True)
+            outs = []
+            for g in builtins.range(len(uniq)):
+                idx = np.nonzero(inverse == g)[0]
+                sub = BlockAccessor.for_block(acc.take(idx)).to_batch(batch_format)
+                outs.append(BlockAccessor.batch_to_block(fn(sub)))
+            return BlockAccessor.concat(outs)
+
+        # group rows together first via sort, then map whole blocks
+        return self._ds.sort(key).map_batches(apply, batch_size=None)
+
+
+# ---------------------------------------------------------------- read API
+
+
+def _plan(op: L.LogicalOp) -> Dataset:
+    return Dataset(L.LogicalPlan(op))
+
+
+def range(n: int, *, parallelism: int = -1, override_num_blocks: Optional[int] = None) -> Dataset:
+    from .datasource import RangeDatasource
+
+    return read_datasource(
+        RangeDatasource(n), parallelism=override_num_blocks or parallelism
+    )
+
+
+def read_datasource(datasource, *, parallelism: int = -1, **_kw) -> Dataset:
+    if parallelism is None or parallelism <= 0:
+        parallelism = DataContext.get_current().read_op_min_num_blocks
+    return _plan(L.Read(datasource=datasource, parallelism=parallelism))
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    from .datasource import ItemsDatasource
+
+    return read_datasource(ItemsDatasource(items), parallelism=parallelism)
+
+
+def from_numpy(arr, column: str = "data") -> Dataset:
+    from .datasource import NumpyDatasource
+
+    arrays = arr if isinstance(arr, list) else [arr]
+    return read_datasource(NumpyDatasource(arrays, column), parallelism=len(arrays))
+
+
+def from_pandas(dfs) -> Dataset:
+    dfs = dfs if isinstance(dfs, list) else [dfs]
+    import ray_tpu
+
+    refs = [
+        ray_tpu.put({c: df[c].to_numpy() for c in df.columns}) for df in dfs
+    ]
+    return _plan(L.FromBlocks(blocks=refs))
+
+
+def from_arrow(tables) -> Dataset:
+    tables = tables if isinstance(tables, list) else [tables]
+    import ray_tpu
+
+    refs = [ray_tpu.put(BlockAccessor.batch_to_block(t)) for t in tables]
+    return _plan(L.FromBlocks(blocks=refs))
+
+
+def read_parquet(paths, *, columns=None, parallelism: int = -1, **_kw) -> Dataset:
+    from .datasource import ParquetDatasource
+
+    return read_datasource(ParquetDatasource(paths, columns), parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    from .datasource import CSVDatasource
+
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    from .datasource import JSONDatasource
+
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    from .datasource import TextDatasource
+
+    return read_datasource(TextDatasource(paths), parallelism=parallelism)
+
+
+def read_binary_files(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    from .datasource import BinaryDatasource
+
+    return read_datasource(BinaryDatasource(paths), parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    from .datasource import FileBasedDatasource
+
+    class NpyDatasource(FileBasedDatasource):
+        def _read_file(self, path: str) -> Block:
+            return {"data": np.load(path)}
+
+    return read_datasource(NpyDatasource(paths), parallelism=parallelism)

@@ -1,0 +1,101 @@
+"""Batch iteration with prefetch + HBM staging.
+
+Parity: python/ray/data/iterator.py + _internal/block_batching/ (format
+conversion, prefetching). TPU-native: ``device_put`` stages the next
+batch into device memory while the current one is being consumed
+(double buffering over the host->HBM DMA), which is how a training loop
+hides input latency behind compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, List, Optional
+
+import numpy as np
+
+from .block import Block, BlockAccessor
+
+_SENTINEL = object()
+
+
+def _rebatch(block_refs, batch_size: Optional[int], drop_last: bool) -> Iterator[Block]:
+    """Coalesce/slice streamed blocks into exact-size batches."""
+    import ray_tpu
+
+    buf: List[Block] = []
+    buffered = 0
+    for ref in block_refs:
+        block = ray_tpu.get(ref)
+        n = BlockAccessor.for_block(block).num_rows()
+        if n == 0:
+            continue
+        if batch_size is None:
+            yield block
+            continue
+        buf.append(block)
+        buffered += n
+        while buffered >= batch_size:
+            merged = BlockAccessor.concat(buf)
+            acc = BlockAccessor.for_block(merged)
+            yield acc.slice(0, batch_size)
+            rest = acc.slice(batch_size, acc.num_rows())
+            buf = [rest]
+            buffered = BlockAccessor.for_block(rest).num_rows()
+    if batch_size is None:
+        return
+    if buffered and not drop_last:
+        merged = BlockAccessor.concat(buf)
+        if BlockAccessor.for_block(merged).num_rows():
+            yield merged
+
+
+def iter_batches(
+    block_refs,
+    *,
+    batch_size: Optional[int],
+    batch_format: str,
+    prefetch_batches: int,
+    drop_last: bool,
+    device_put: Any = None,
+) -> Iterator[Any]:
+    def produce() -> Iterator[Any]:
+        for block in _rebatch(block_refs, batch_size, drop_last):
+            batch = BlockAccessor.for_block(block).to_batch(batch_format)
+            if device_put is not None:
+                import jax
+
+                batch = jax.tree.map(
+                    lambda v: jax.device_put(np.ascontiguousarray(v), device_put)
+                    if isinstance(v, np.ndarray) and v.dtype != object
+                    else v,
+                    batch,
+                )
+            yield batch
+
+    if prefetch_batches <= 0:
+        yield from produce()
+        return
+
+    q: "queue.Queue" = queue.Queue(maxsize=prefetch_batches)
+    err: List[BaseException] = []
+
+    def worker():
+        try:
+            for item in produce():
+                q.put(item)
+        except BaseException as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=worker, daemon=True, name="data-prefetch")
+    t.start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            break
+        yield item
+    if err:
+        raise err[0]

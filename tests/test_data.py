@@ -1,0 +1,225 @@
+"""Data library tests.
+
+Pattern from the reference (python/ray/data/tests/): small datasets
+against a real runtime; assert transform semantics, shuffle/sort
+correctness, actor-pool UDFs, iteration formats.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.data import ActorPoolStrategy, Count, Max, Mean, Sum
+
+
+@pytest.fixture
+def ray4(ray_start_4_cpus):
+    yield ray_start_4_cpus
+
+
+class TestBasics:
+    def test_range_count_take(self, ray4):
+        ds = rd.range(100)
+        assert ds.count() == 100
+        rows = ds.take(5)
+        assert [r["id"] for r in rows] == [0, 1, 2, 3, 4]
+
+    def test_from_items(self, ray4):
+        ds = rd.from_items([{"x": i, "y": i * 2} for i in range(10)])
+        assert ds.count() == 10
+        assert ds.take(2) == [{"x": 0, "y": 0}, {"x": 1, "y": 2}]
+
+    def test_schema_columns(self, ray4):
+        ds = rd.range(10)
+        assert ds.schema() == {"id": "int64"}
+        assert ds.columns() == ["id"]
+
+    def test_from_numpy_pandas(self, ray4):
+        import pandas as pd
+
+        ds = rd.from_numpy(np.arange(12).reshape(4, 3))
+        assert ds.count() == 4
+        df = rd.from_pandas(pd.DataFrame({"a": [1, 2], "b": [3.0, 4.0]})).to_pandas()
+        assert list(df["a"]) == [1, 2]
+
+
+class TestTransforms:
+    def test_map(self, ray4):
+        ds = rd.range(10).map(lambda r: {"id": r["id"] * 2})
+        assert [r["id"] for r in ds.take(3)] == [0, 2, 4]
+
+    def test_filter(self, ray4):
+        ds = rd.range(20).filter(lambda r: r["id"] % 2 == 0)
+        assert ds.count() == 10
+
+    def test_flat_map(self, ray4):
+        ds = rd.from_items([{"x": 1}, {"x": 2}]).flat_map(
+            lambda r: [{"x": r["x"]}, {"x": -r["x"]}]
+        )
+        assert sorted(r["x"] for r in ds.take_all()) == [-2, -1, 1, 2]
+
+    def test_map_batches_numpy(self, ray4):
+        ds = rd.range(32).map_batches(lambda b: {"id": b["id"] + 1})
+        assert [r["id"] for r in ds.take(3)] == [1, 2, 3]
+
+    def test_map_batches_batch_size(self, ray4):
+        sizes = []
+
+        def record(b):
+            return {"n": np.array([len(b["id"])])}
+
+        ds = rd.range(100, override_num_blocks=1).map_batches(record, batch_size=30)
+        got = sorted(r["n"] for r in ds.take_all())
+        assert got == [10, 30, 30, 30]
+
+    def test_map_batches_pandas_format(self, ray4):
+        def f(df):
+            df["y"] = df["id"] * 3
+            return df
+
+        ds = rd.range(10).map_batches(f, batch_format="pandas")
+        assert ds.take(2)[1]["y"] == 3
+
+    def test_fusion_chains_maps(self, ray4):
+        from ray_tpu.data._internal.executor import build_stages
+
+        ds = rd.range(10).map(lambda r: r).filter(lambda r: True).map_batches(lambda b: b)
+        stages = build_stages(ds._logical)
+        # read + 3 one-to-one ops fuse into ONE read stage
+        assert len(stages) == 1
+        assert stages[0].kind == "read"
+
+    def test_add_drop_select_columns(self, ray4):
+        ds = rd.range(5).add_column("sq", lambda b: b["id"] ** 2)
+        assert ds.take(3)[2]["sq"] == 4
+        assert ds.drop_columns(["sq"]).columns() == ["id"]
+        assert ds.select_columns(["sq"]).columns() == ["sq"]
+
+    def test_limit(self, ray4):
+        assert rd.range(100).limit(7).count() == 7
+
+
+class TestActorPool:
+    def test_class_udf_actor_pool(self, ray4):
+        class AddConst:
+            def __init__(self, c):
+                self.c = c
+
+            def __call__(self, batch):
+                return {"id": batch["id"] + self.c}
+
+        ds = rd.range(16).map_batches(
+            AddConst,
+            fn_constructor_args=(100,),
+            compute=ActorPoolStrategy(size=2),
+        )
+        vals = sorted(r["id"] for r in ds.take_all())
+        assert vals == list(range(100, 116))
+
+
+class TestShufflesSorts:
+    def test_repartition(self, ray4):
+        ds = rd.range(20).repartition(4).materialize()
+        assert ds.num_blocks() == 4
+        assert ds.count() == 20
+
+    def test_random_shuffle_preserves_rows(self, ray4):
+        ds = rd.range(50).random_shuffle(seed=42)
+        vals = sorted(r["id"] for r in ds.take_all())
+        assert vals == list(range(50))
+
+    def test_sort(self, ray4):
+        ds = rd.from_items([{"v": x} for x in [5, 3, 9, 1, 7, 2, 8]]).sort("v")
+        assert [r["v"] for r in ds.take_all()] == [1, 2, 3, 5, 7, 8, 9]
+
+    def test_sort_descending(self, ray4):
+        ds = rd.from_items([{"v": x} for x in [5, 3, 9]]).sort("v", descending=True)
+        assert [r["v"] for r in ds.take_all()] == [9, 5, 3]
+
+    def test_groupby_aggregate(self, ray4):
+        items = [{"k": i % 3, "v": float(i)} for i in range(12)]
+        ds = rd.from_items(items).groupby("k").sum("v")
+        rows = sorted(ds.take_all(), key=lambda r: r["k"])
+        assert [r["sum(v)"] for r in rows] == [18.0, 22.0, 26.0]
+
+    def test_global_aggregate(self, ray4):
+        out = rd.range(10).aggregate(Sum("id"), Max("id"), Mean("id"))
+        assert out["sum(id)"] == 45
+        assert out["max(id)"] == 9
+        assert out["mean(id)"] == 4.5
+
+    def test_union_zip(self, ray4):
+        a = rd.from_items([{"x": 1}, {"x": 2}])
+        b = rd.from_items([{"x": 3}])
+        assert a.union(b).count() == 3
+        z = rd.from_items([{"l": 1}]).zip(rd.from_items([{"r": 2}]))
+        assert z.take_all() == [{"l": 1, "r": 2}]
+
+
+class TestConsumption:
+    def test_iter_batches_sizes(self, ray4):
+        batches = list(rd.range(25).iter_batches(batch_size=10))
+        assert [len(b["id"]) for b in batches] == [10, 10, 5]
+
+    def test_iter_batches_drop_last(self, ray4):
+        batches = list(rd.range(25).iter_batches(batch_size=10, drop_last=True))
+        assert [len(b["id"]) for b in batches] == [10, 10]
+
+    def test_iter_batches_device_put(self, ray4):
+        import jax
+
+        dev = jax.devices()[0]
+        batches = list(
+            rd.range(8).iter_batches(batch_size=8, device_put=dev)
+        )
+        assert len(batches) == 1
+        assert isinstance(batches[0]["id"], jax.Array)
+
+    def test_split(self, ray4):
+        parts = rd.range(10).split(2)
+        assert [p.count() for p in parts] == [5, 5]
+
+    def test_take_batch(self, ray4):
+        b = rd.range(100).take_batch(5)
+        np.testing.assert_array_equal(b["id"], np.arange(5))
+
+    def test_iter_torch_batches(self, ray4):
+        import torch
+
+        b = next(iter(rd.range(6).iter_torch_batches(batch_size=6)))
+        assert isinstance(b["id"], torch.Tensor)
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, ray4, tmp_path):
+        path = str(tmp_path / "pq")
+        rd.range(30).write_parquet(path)
+        ds = rd.read_parquet(path)
+        assert ds.count() == 30
+        assert sorted(r["id"] for r in ds.take_all()) == list(range(30))
+
+    def test_csv_roundtrip(self, ray4, tmp_path):
+        path = str(tmp_path / "csv")
+        rd.from_items([{"a": i, "b": i * 1.5} for i in range(10)]).write_csv(path)
+        ds = rd.read_csv(path)
+        assert ds.count() == 10
+
+    def test_json_roundtrip(self, ray4, tmp_path):
+        path = str(tmp_path / "js")
+        rd.from_items([{"a": i} for i in range(5)]).write_json(path)
+        assert rd.read_json(path).count() == 5
+
+    def test_read_text(self, ray4, tmp_path):
+        p = tmp_path / "t.txt"
+        p.write_text("alpha\nbeta\ngamma\n")
+        ds = rd.read_text(str(p))
+        assert [r["text"] for r in ds.take_all()] == ["alpha", "beta", "gamma"]
+
+    def test_read_binary(self, ray4, tmp_path):
+        p = tmp_path / "b.bin"
+        p.write_bytes(b"\x00\x01")
+        rows = rd.read_binary_files(str(p)).take_all()
+        assert rows[0]["bytes"] == b"\x00\x01"
