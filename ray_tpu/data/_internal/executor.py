@@ -34,6 +34,8 @@ from . import plan as L
 def _apply_one(op_kind: str, fn: Callable, spec: dict, block: Block) -> List[Block]:
     """Apply one logical op to one block, returning output blocks."""
     acc = BlockAccessor.for_block(block)
+    if acc.num_rows() == 0:
+        return []  # drop empty blocks; never invoke UDFs on them
     if op_kind == "map_rows":
         return [BlockAccessor.batch_to_block([fn(r) for r in acc.iter_rows()])]
     if op_kind == "filter":
@@ -49,11 +51,9 @@ def _apply_one(op_kind: str, fn: Callable, spec: dict, block: Block) -> List[Blo
         fmt = spec.get("batch_format", "numpy")
         n = acc.num_rows()
         out: List[Block] = []
-        step = bs or max(n, 1)
-        for lo in range(0, max(n, 1), step):
+        step = bs or n
+        for lo in range(0, n, step):
             sub = BlockAccessor.for_block(acc.slice(lo, min(lo + step, n)))
-            if sub.num_rows() == 0 and n > 0:
-                continue
             res = fn(sub.to_batch(fmt))
             out.append(BlockAccessor.batch_to_block(res))
         return out
@@ -70,16 +70,26 @@ def _apply_chain(chain: List[Tuple[str, Callable, dict]], block: Block) -> List[
     return blocks
 
 
+def _publish(blocks: List[Block]) -> List[Any]:
+    """Worker-side: put each output block into the object store and
+    return just the refs — blocks never round-trip through the driver
+    (the reference's tasks likewise seal blocks into plasma and ship
+    RefBundles of metadata, §3.5 step 3)."""
+    import ray_tpu
+
+    return [ray_tpu.put(b) for b in blocks]
+
+
 def _run_read_task(read_fn: Callable, chain: List[Tuple[str, Callable, dict]]):
     """Worker-side: run a ReadTask then the fused transform chain."""
     out: List[Block] = []
     for block in read_fn():
         out.extend(_apply_chain(chain, block))
-    return out
+    return _publish(out)
 
 
 def _run_chain_task(chain: List[Tuple[str, Callable, dict]], block: Block):
-    return _apply_chain(chain, block)
+    return _publish(_apply_chain(chain, block))
 
 
 class _ChainActor:
@@ -94,8 +104,8 @@ class _ChainActor:
                 fn = fn(*ctor_args, **ctor_kwargs)
             self.chain.append((kind, fn, spec))
 
-    def run(self, block: Block) -> List[Block]:
-        return _apply_chain(self.chain, block)
+    def run(self, block: Block) -> List[Any]:
+        return _publish(_apply_chain(self.chain, block))
 
 
 # ------------------------------------------------------------ physical plan
@@ -285,11 +295,10 @@ class StreamingExecutor:
         return ray_tpu
 
     def _flatten_refs(self, list_ref) -> List[Any]:
-        """A task returned List[Block]; re-publish each block as its own
-        ref so downstream granularity stays per-block."""
+        """A task returned List[ObjectRef] (blocks already published by
+        the worker); only the small ref list crosses to the driver."""
         ray = self._ray()
-        blocks = ray.get(list_ref)
-        return [ray.put(b) for b in blocks]
+        return list(ray.get(list_ref))
 
     def _run_read(self, stage: _ReadStage) -> Iterator[Any]:
         ray = self._ray()
@@ -546,6 +555,8 @@ class StreamingExecutor:
         k = max(1, min(len(refs), 8))
 
         def split_hash(block: Block, k: int) -> List[Block]:
+            import zlib
+
             acc = BlockAccessor.for_block(block)
             if key is None:
                 return [block] + [acc.slice(0, 0)] * (k - 1)
@@ -553,7 +564,11 @@ class StreamingExecutor:
                 vals = block[key]
             else:
                 vals = np.asarray([r[key] for r in block])
-            hashes = np.asarray([hash(v) % k for v in vals])
+            # deterministic cross-process hash: Python's hash() is salted
+            # per-process, which would scatter one key over partitions
+            hashes = np.asarray(
+                [zlib.crc32(repr(v).encode()) % k for v in vals]
+            )
             return [acc.take(np.nonzero(hashes == i)[0]) for i in range(k)]
 
         parts: List[List[Any]] = [[] for _ in range(k)]

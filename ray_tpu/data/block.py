@@ -153,9 +153,12 @@ class BlockAccessor:
     # --------------------------------------------------------- combine
     @staticmethod
     def concat(blocks: List[Block]) -> Block:
-        blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
-        if not blocks:
-            return []
+        nonempty = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
+        if not nonempty:
+            # preserve columnar schema of empty inputs rather than
+            # degrading to a row-list (downstream UDFs index columns)
+            return blocks[0] if blocks else []
+        blocks = nonempty
         if all(isinstance(b, dict) for b in blocks):
             keys = list(blocks[0].keys())
             return {k: np.concatenate([b[k] for b in blocks]) for k in keys}

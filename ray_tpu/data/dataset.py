@@ -286,7 +286,6 @@ class Dataset:
         total = sum(c for _, c in rows)
         per = total // n
         out: List[Dataset] = []
-        it = iter(rows)
         carry: List[Tuple[Any, int]] = list(rows)
         # simple greedy contiguous partition by row count
         targets = [per + (1 if i < total % n else 0) for i in builtins.range(n)]
@@ -320,10 +319,10 @@ class Dataset:
 
     def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
         ds = self.random_shuffle(seed=seed) if shuffle else self
-        total = ds.count()
-        n_test = int(total * test_size) if isinstance(test_size, float) else test_size
-        mat = ds.materialize()
+        mat = ds.materialize()  # execute ONCE; count + slice from the cache
         rows = mat.take_all()
+        total = len(rows)
+        n_test = int(total * test_size) if isinstance(test_size, float) else test_size
         train, test = rows[: total - n_test], rows[total - n_test :]
         return from_items(train), from_items(test)
 

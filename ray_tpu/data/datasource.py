@@ -101,13 +101,16 @@ def _expand_paths(paths) -> List[str]:
     for p in paths:
         p = os.path.expanduser(p)
         if os.path.isdir(p):
-            out.extend(
-                sorted(
-                    os.path.join(p, f)
-                    for f in os.listdir(p)
-                    if not f.startswith(".")
+            # recursive walk, files only (hive-style partition dirs etc.)
+            found = []
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if not d.startswith((".", "_"))]
+                found.extend(
+                    os.path.join(root, f)
+                    for f in files
+                    if not f.startswith((".", "_"))
                 )
-            )
+            out.extend(sorted(found))
         elif any(ch in p for ch in "*?["):
             out.extend(sorted(globmod.glob(p)))
         else:

@@ -80,22 +80,41 @@ def iter_batches(
 
     q: "queue.Queue" = queue.Queue(maxsize=prefetch_batches)
     err: List[BaseException] = []
+    stop = threading.Event()
 
     def worker():
         try:
             for item in produce():
-                q.put(item)
+                # bounded put that aborts if the consumer abandoned the
+                # iterator (otherwise this thread would pin prefetched
+                # HBM batches for the life of the process)
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
         except BaseException as e:  # surfaced on the consumer side
             err.append(e)
         finally:
-            q.put(_SENTINEL)
+            while not stop.is_set():  # consumer still listening
+                try:
+                    q.put(_SENTINEL, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
 
     t = threading.Thread(target=worker, daemon=True, name="data-prefetch")
     t.start()
-    while True:
-        item = q.get()
-        if item is _SENTINEL:
-            break
-        yield item
-    if err:
-        raise err[0]
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                break
+            yield item
+        if err:
+            raise err[0]
+    finally:
+        stop.set()
