@@ -204,17 +204,45 @@ def _attention_xla(q, k, v, config: LlamaConfig, *, causal: bool = True):
     return out.reshape(B, S, H, hd)
 
 
+def _attention_ring(q, k, v, config: LlamaConfig):
+    """Sequence-parallel attention: activations sharded (batch on
+    data/fsdp, sequence on seq); the ring runs inside shard_map against
+    the ambient mesh, rotating KV shards over ICI. Falls back to flash
+    when there is no ambient mesh or the seq axis is trivial."""
+    from jax.sharding import get_abstract_mesh
+
+    from ray_tpu.ops.attention import flash_attention
+    from ray_tpu.ops.ring_attention import ring_attention
+
+    mesh = get_abstract_mesh()
+    if mesh is None or mesh.empty or dict(mesh.shape).get("seq", 1) == 1:
+        return flash_attention(q, k, v, causal=True)
+    # keep heads sharded over the TP axis inside the ring (qkv arrive
+    # head-sharded from the model-split projections; replicating them
+    # here would duplicate the whole ring per TP rank)
+    tp = dict(mesh.shape).get("model", 1)
+    kvh = k.shape[2]
+    head_axis = "model" if (kvh % tp == 0 and q.shape[2] % tp == 0) else None
+    spec = P(("data", "fsdp"), "seq", head_axis, None)
+    return jax.shard_map(
+        partial(ring_attention, axis_name="seq"),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 def _attention(q, k, v, config: LlamaConfig):
     if config.attention_impl == "flash":
         from ray_tpu.ops.attention import flash_attention
 
         return flash_attention(q, k, v, causal=True)
+    if config.attention_impl == "ring":
+        return _attention_ring(q, k, v, config)
     if config.attention_impl != "xla":
         raise ValueError(
             f"unknown attention_impl {config.attention_impl!r}; "
-            "expected 'xla' or 'flash' (sequence-parallel ring attention "
-            "is driven from ray_tpu.ops.ring_attention via shard_map, "
-            "not per-block config)"
+            "expected 'xla', 'flash', or 'ring' (sequence parallel)"
         )
     return _attention_xla(q, k, v, config)
 

@@ -1,7 +1,19 @@
-"""TPU compute kernels: flash/ring attention, MoE dispatch, collective
-helpers. XLA blockwise fallbacks keep every op runnable on the CPU test
-mesh; Pallas kernels take over on real TPU."""
+"""TPU compute kernels: flash/ring/Ulysses attention, MoE dispatch.
+XLA blockwise fallbacks keep every op runnable on the CPU test mesh;
+Pallas kernels take over on real TPU."""
 
 from .attention import flash_attention
+from .moe import MoEConfig, init_moe_params, moe_ffn, top_k_gating
+from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention
 
-__all__ = ["flash_attention"]
+__all__ = [
+    "MoEConfig",
+    "flash_attention",
+    "init_moe_params",
+    "moe_ffn",
+    "ring_attention",
+    "ring_attention_sharded",
+    "top_k_gating",
+    "ulysses_attention",
+]

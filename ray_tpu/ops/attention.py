@@ -1,15 +1,21 @@
 """Memory-efficient causal attention (flash-attention algorithm).
 
 Online-softmax blockwise attention: O(S) memory instead of the O(S^2)
-logits tensor. Two code paths behind one signature:
+logits tensor. Three consumers share the core accumulate step:
 
-- ``flash_attention`` — blockwise `lax.scan` formulation that XLA fuses
-  well on any backend (and is the CPU-mesh test path).
+- ``flash_attention`` — single-device blockwise `lax.scan` formulation
+  that XLA fuses well on any backend (the CPU-mesh test path).
+- ``ray_tpu.ops.ring_attention`` — sequence-parallel ring schedule that
+  feeds successive KV shards through the same accumulator.
 - A Pallas TPU kernel (ray_tpu.ops.pallas_attention) is substituted on
   TPU when available; same semantics, hand-tiled for MXU/VMEM.
 
 Supports GQA (n_kv_heads divides n_heads). Layout: q (B, S, H, hd),
 k/v (B, T, KVH, hd) — the layout ray_tpu.models uses.
+
+Reference parity note: the reference has NO sequence-parallel or
+flash-attention code (SURVEY.md §5.7 — delegated to vLLM/torch); this
+is TPU-native net-new capability.
 """
 
 from __future__ import annotations
@@ -21,13 +27,23 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+_NEG_INF = -1e30
 
-def _blockwise_attention(q, k, v, *, causal: bool, block_q: int, block_kv: int,
-                         q_offset: int = 0, kv_offset: int = 0):
-    """Core online-softmax loop. Shapes:
-    q (B, Sq, KVH, G, hd), k/v (B, Skv, KVH, hd). fp32 accumulation.
-    ``q_offset``/``kv_offset`` are absolute position offsets (used by
-    ring attention, where each shard holds a slice of the sequence).
+
+def _blockwise_accum(
+    q, k, v, acc, m, l, *, causal: bool, block_q: int, block_kv: int,
+    q_offset=0, kv_offset=0,
+):
+    """Accumulate attention of q against one K/V span into running
+    online-softmax state. Shapes: q (B, Sq, KVH, G, hd), k/v
+    (B, Skv, KVH, hd); acc (B, Sq, KVH, G, hd) f32, m/l (B, Sq, KVH, G)
+    f32. ``q_offset``/``kv_offset`` may be tracers (ring attention
+    passes the rotating shard's absolute position).
+
+    Returns updated (acc, m, l). Fully-masked blocks are exact no-ops:
+    masked probabilities are explicitly zeroed (relying on exp(-big)
+    underflow is wrong when a block is masked BEFORE any visible block
+    has set a finite running max).
     """
     B, Sq, KVH, G, hd = q.shape
     Skv = k.shape[1]
@@ -40,15 +56,15 @@ def _blockwise_attention(q, k, v, *, causal: bool, block_q: int, block_kv: int,
     qb = q.reshape(B, nq, block_q, KVH, G, hd)
     kb = k.reshape(B, nkv, block_kv, KVH, hd)
     vb = v.reshape(B, nkv, block_kv, KVH, hd)
+    accb = acc.reshape(B, nq, block_q, KVH, G, hd)
+    mb = m.reshape(B, nq, block_q, KVH, G)
+    lb = l.reshape(B, nq, block_q, KVH, G)
 
     q_pos = q_offset + jnp.arange(Sq).reshape(nq, block_q)
     kv_pos = kv_offset + jnp.arange(Skv).reshape(nkv, block_kv)
 
-    def per_qblock(qi, q_blk):
-        # q_blk: (B, block_q, KVH, G, hd)
-        acc0 = jnp.zeros((B, block_q, KVH, G, hd), jnp.float32)
-        m0 = jnp.full((B, block_q, KVH, G), -jnp.inf, jnp.float32)
-        l0 = jnp.zeros((B, block_q, KVH, G), jnp.float32)
+    def per_qblock(args):
+        qi, q_blk, acc0, m0, l0 = args
 
         def body(carry, inputs):
             acc, m, l = carry
@@ -59,10 +75,18 @@ def _blockwise_attention(q, k, v, *, causal: bool, block_q: int, block_kv: int,
             ) * scale
             if causal:
                 mask = q_pos[qi][:, None] >= kv_pos[ki][None, :]
-                logits = jnp.where(mask[None, :, None, None, :], logits, -1e30)
-            m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-            p = jnp.exp(logits - m_new[..., None])
-            corr = jnp.exp(m - m_new)
+                logits = jnp.where(mask[None, :, None, None, :], logits, _NEG_INF)
+            blk_max = jnp.max(logits, axis=-1)
+            m_new = jnp.maximum(m, blk_max)
+            # clamp for exp() only — fully-masked rows keep m_new=-inf
+            # in the carry but compute with 0 to avoid inf/nan
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(logits - m_safe[..., None])
+            if causal:
+                p = jnp.where(mask[None, :, None, None, :], p, 0.0)
+            corr = jnp.where(
+                jnp.isfinite(m), jnp.exp(m - m_safe), 0.0
+            )
             l = l * corr + jnp.sum(p, axis=-1)
             pv = jnp.einsum(
                 "bqkgt,btkh->bqkgh", p.astype(v_blk.dtype), v_blk,
@@ -75,14 +99,36 @@ def _blockwise_attention(q, k, v, *, causal: bool, block_q: int, block_kv: int,
             body, (acc0, m0, l0),
             (jnp.arange(nkv), jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0)),
         )
-        return acc / jnp.maximum(l, 1e-30)[..., None]
+        return acc, m, l
 
     out = jax.lax.map(
-        lambda args: per_qblock(*args),
-        (jnp.arange(nq), jnp.moveaxis(qb, 1, 0)),
-    )  # (nq, B, block_q, KVH, G, hd)
-    out = jnp.moveaxis(out, 0, 1).reshape(B, Sq, KVH, G, hd)
-    return out
+        per_qblock,
+        (
+            jnp.arange(nq),
+            jnp.moveaxis(qb, 1, 0),
+            jnp.moveaxis(accb, 1, 0),
+            jnp.moveaxis(mb, 1, 0),
+            jnp.moveaxis(lb, 1, 0),
+        ),
+    )
+    acc2, m2, l2 = (jnp.moveaxis(t, 0, 1) for t in out)
+    return (
+        acc2.reshape(B, Sq, KVH, G, hd),
+        m2.reshape(B, Sq, KVH, G),
+        l2.reshape(B, Sq, KVH, G),
+    )
+
+
+def init_attention_state(B, Sq, KVH, G, hd):
+    return (
+        jnp.zeros((B, Sq, KVH, G, hd), jnp.float32),
+        jnp.full((B, Sq, KVH, G), -jnp.inf, jnp.float32),
+        jnp.zeros((B, Sq, KVH, G), jnp.float32),
+    )
+
+
+def finalize_attention_state(acc, l):
+    return acc / jnp.maximum(l, 1e-30)[..., None]
 
 
 def flash_attention(
@@ -115,7 +161,9 @@ def flash_attention(
             pass
 
     qg = q.reshape(B, S, KVH, G, hd)
-    out = _blockwise_attention(
-        qg, k, v, causal=causal, block_q=block_q, block_kv=block_kv
+    acc, m, l = init_attention_state(B, S, KVH, G, hd)
+    acc, m, l = _blockwise_accum(
+        qg, k, v, acc, m, l, causal=causal, block_q=block_q, block_kv=block_kv
     )
+    out = finalize_attention_state(acc, l)
     return out.reshape(B, S, H, hd).astype(q.dtype)

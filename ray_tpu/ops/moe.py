@@ -1,0 +1,126 @@
+"""Mixture-of-Experts: top-k routing + expert-parallel dispatch.
+
+Absent from the reference (SURVEY.md §2.5 — MoE delegated to
+vLLM/deepspeed downstream); built TPU-native. The dispatch/combine are
+dense einsums against a capacity-bounded one-hot dispatch tensor — the
+MXU-friendly formulation (no gathers/scatters, static shapes), with the
+expert dimension sharded over the `expert` mesh axis so XLA lowers the
+dispatch einsum into an all-to-all over ICI.
+
+Pieces:
+- ``top_k_gating``: softmax router with top-k, capacity dropping, and
+  the standard load-balancing auxiliary loss.
+- ``moe_ffn``: routed expert FFN (SwiGLU experts) usable inside any
+  jitted model; shard params' leading E dim on the `expert` axis.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GatingResult(NamedTuple):
+    dispatch: jax.Array  # (T, E, C) one-hot-ish dispatch weights in {0,1}
+    combine: jax.Array  # (T, E, C) combine weights (gate probs)
+    aux_loss: jax.Array  # scalar load-balance loss
+    expert_load: jax.Array  # (E,) fraction of tokens per expert
+
+
+def top_k_gating(
+    logits: jax.Array,  # (T, E) router logits
+    *,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    min_capacity: int = 4,
+) -> GatingResult:
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    capacity = max(min_capacity, int(math.ceil(T * k * capacity_factor / E)))
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (T, k)
+    # renormalize the selected gates
+    gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, choice) within its expert's capacity:
+    # cumulative count of prior assignments to the same expert
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (T, k, E)
+    flat = onehot.reshape(T * k, E)
+    # priority order: all k=0 choices first, then k=1 (standard
+    # switch/gshard ordering keeps top-1 assignments dense)
+    order = jnp.arange(T * k).reshape(T, k).T.reshape(-1)  # choice-major
+    flat_ordered = flat[order]
+    pos_ordered = jnp.cumsum(flat_ordered, axis=0) - flat_ordered  # (T*k, E)
+    inv = jnp.argsort(order)
+    pos = pos_ordered[inv].reshape(T, k, E)
+    slot = (pos * onehot).sum(-1)  # (T, k) slot within expert
+    keep = slot < capacity
+
+    disp = (
+        jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)[..., None]
+        * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)[:, :, None, :]
+        * keep[:, :, None, None]
+    )  # (T, k, E, C)
+    dispatch = disp.sum(1)  # (T, E, C)
+    combine = (disp * gate_vals[:, :, None, None]).sum(1)
+
+    # load-balance aux loss (Switch Transformer): E * sum(f_e * p_e)
+    me = probs.mean(0)  # mean router prob per expert
+    ce = onehot.sum(1).astype(jnp.float32).mean(0)  # fraction routed (pre-drop)
+    aux = (me * ce).sum() * E
+    return GatingResult(dispatch, combine, aux, ce)
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    n_experts: int
+    k: int = 2
+    capacity_factor: float = 1.25
+
+
+def init_moe_params(key, config: MoEConfig, dtype=jnp.bfloat16):
+    kw, k1, k2, k3 = jax.random.split(key, 4)
+    E, D, F = config.n_experts, config.d_model, config.d_ff
+    s_in = 1.0 / math.sqrt(D)
+    s_out = 1.0 / math.sqrt(F)
+    return {
+        "router": (jax.random.normal(kw, (D, E)) * s_in).astype(jnp.float32),
+        "w_gate": (jax.random.normal(k1, (E, D, F)) * s_in).astype(dtype),
+        "w_up": (jax.random.normal(k2, (E, D, F)) * s_in).astype(dtype),
+        "w_down": (jax.random.normal(k3, (E, F, D)) * s_out).astype(dtype),
+    }
+
+
+def moe_ffn(
+    params: dict,
+    x: jax.Array,  # (B, S, D)
+    config: MoEConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """Routed SwiGLU expert FFN. Returns (out (B,S,D), aux_loss).
+
+    Shard ``params['w_*']`` dim 0 on the `expert` mesh axis and the
+    dispatched tokens follow via GSPMD all-to-all; activations stay
+    sharded over batch/sequence axes.
+    """
+    B, S, D = x.shape
+    T = B * S
+    xt = x.reshape(T, D)
+    logits = xt.astype(jnp.float32) @ params["router"]
+    gate = top_k_gating(
+        logits, k=config.k, capacity_factor=config.capacity_factor
+    )
+    # dispatch: (T,D),(T,E,C) -> (E,C,D)
+    xe = jnp.einsum("td,tec->ecd", xt, gate.dispatch.astype(x.dtype))
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, params["w_gate"])) * jnp.einsum(
+        "ecd,edf->ecf", xe, params["w_up"]
+    )
+    ye = jnp.einsum("ecf,efd->ecd", h, params["w_down"])  # (E,C,D)
+    # combine back: (E,C,D),(T,E,C) -> (T,D)
+    out = jnp.einsum("ecd,tec->td", ye, gate.combine.astype(x.dtype))
+    return out.reshape(B, S, D), gate.aux_loss

@@ -1,0 +1,126 @@
+"""Ring attention: sequence/context parallelism over the `seq` mesh axis.
+
+Absent from the reference (SURVEY.md §2.5, §5.7 — no ring-attention/
+Ulysses/context-parallel code exists in its tree); built TPU-native:
+Q/K/V are sharded over sequence on the `seq` axis; each of the N chips
+computes blockwise attention of its local Q against the K/V shard it
+currently holds, then rotates K/V one hop around the ICI ring with
+`lax.ppermute`. After N steps every Q shard has attended to the full
+sequence with O(S/N) memory per chip, and the permute of step i
+overlaps the compute of step i+1 (XLA's latency-hiding scheduler
+overlaps independent collective/compute on TPU).
+
+Causality is exact across shards: each rotating K/V shard carries its
+absolute offset into the blockwise mask, and fully-future shards
+contribute exactly nothing (see _blockwise_accum's masked-probability
+handling).
+
+Usage: inside shard_map with an axis named ``axis_name``:
+
+    out = ring_attention(q_shard, k_shard, v_shard, axis_name="seq")
+
+or use ``ring_attention_sharded`` (this module) for the jit-level
+wrapper that builds the shard_map against a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .attention import (
+    _blockwise_accum,
+    finalize_attention_state,
+    init_attention_state,
+)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jax.Array:
+    """Per-shard ring attention; call inside shard_map/pmap with
+    ``axis_name`` bound. q (B, S_local, H, hd); k/v (B, S_local, KVH, hd).
+    """
+    B, Sq, H, hd = q.shape
+    KVH = k.shape[2]
+    G = H // KVH
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    q_off = idx * Sq
+    qg = q.reshape(B, Sq, KVH, G, hd)
+
+    acc, m, l = init_attention_state(B, Sq, KVH, G, hd)
+
+    def step(i, carry):
+        acc, m, l, k_cur, v_cur = carry
+        # shard currently held started at rank (idx - i) mod n
+        kv_idx = jax.lax.rem(idx - i + n, n)
+        kv_off = kv_idx * k_cur.shape[1]
+        acc, m, l = _blockwise_accum(
+            qg, k_cur, v_cur, acc, m, l,
+            causal=causal, block_q=block_q, block_kv=block_kv,
+            q_offset=q_off, kv_offset=kv_off,
+        )
+        # rotate KV one hop: rank r hands its shard to r+1 (ring on ICI)
+        k_nxt = jax.lax.ppermute(
+            k_cur, axis_name, [(r, (r + 1) % n) for r in range(n)]
+        )
+        v_nxt = jax.lax.ppermute(
+            v_cur, axis_name, [(r, (r + 1) % n) for r in range(n)]
+        )
+        return acc, m, l, k_nxt, v_nxt
+
+    # n (a mesh axis size) is a static Python int under shard_map —
+    # psum of a constant folds — so a Python loop unrolls the ring,
+    # keeping each step's permute/compute visible to XLA's scheduler
+    # for compute/communication overlap.
+    carry = (acc, m, l, k, v)
+    for i in range(int(n)):
+        carry = step(i, carry)
+    acc, m, l, _, _ = carry
+    out = finalize_attention_state(acc, l)
+    return out.reshape(B, Sq, H, hd).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    block_q: int = 512,
+    block_kv: int = 512,
+) -> jax.Array:
+    """Jit-level wrapper for a single-axis seq mesh: S sharded over
+    ``axis_name``, B/H replicated. For multi-axis meshes (batch on
+    data/fsdp, heads on model) build the shard_map directly with the
+    full spec — see models/llama.py _attention_ring."""
+    from jax import shard_map
+
+    spec = P(None, axis_name, None, None)
+
+    fn = functools.partial(
+        ring_attention,
+        axis_name=axis_name,
+        causal=causal,
+        block_q=block_q,
+        block_kv=block_kv,
+    )
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

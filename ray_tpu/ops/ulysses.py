@@ -1,0 +1,64 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all reshard.
+
+Absent from the reference (SURVEY.md §5.7); built TPU-native. Where
+ring attention rotates K/V around the ring, Ulysses does two
+all-to-alls: reshard activations from sequence-sharded to HEAD-sharded
+(each chip gets the FULL sequence for a subset of heads), run ordinary
+local attention, then reshard back. On TPU the all-to-all is a single
+XLA collective over ICI; it's preferable to the ring when
+heads >= seq-parallel degree and the sequence fits per-chip HBM at
+S × H/N.
+
+Call inside shard_map with the sequence axis bound to ``axis_name``:
+
+    out = ulysses_attention(q, k, v, axis_name="seq")
+
+q (B, S_local, H, hd); requires H % axis_size == 0 and
+KVH % axis_size == 0 (pad KV heads up to the degree for stronger GQA).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .attention import flash_attention
+
+
+def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """(B, S/N, H, hd) seq-sharded -> (B, S, H/N, hd) head-sharded."""
+    # all_to_all: split the head dim across the axis, gather sequence
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def _heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """(B, S, H/N, hd) head-sharded -> (B, S/N, H, hd) seq-sharded."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> jax.Array:
+    n = jax.lax.psum(1, axis_name)
+    B, S_local, H, hd = q.shape
+    KVH = k.shape[2]
+    if H % n != 0:
+        raise ValueError(f"n_heads {H} must divide by seq-parallel degree {n}")
+    if KVH % n != 0:
+        raise ValueError(
+            f"n_kv_heads {KVH} must divide by seq-parallel degree {n}; "
+            "replicate/pad KV heads up to the degree for GQA models"
+        )
+    qh = _seq_to_heads(q, axis_name)  # (B, S, H/N, hd)
+    kh = _seq_to_heads(k, axis_name)
+    vh = _seq_to_heads(v, axis_name)
+    out = flash_attention(qh, kh, vh, causal=causal)
+    return _heads_to_seq(out, axis_name)

@@ -12,6 +12,7 @@ from .mesh import (
     mesh_axis_size,
     single_device_mesh,
 )
+from .pipeline import pipeline_apply, pipeline_sharded
 from .train_step import (
     TrainState,
     create_train_state,
@@ -32,6 +33,8 @@ __all__ = [
     "mesh_axis_size",
     "single_device_mesh",
     "TrainState",
+    "pipeline_apply",
+    "pipeline_sharded",
     "create_train_state",
     "default_optimizer",
     "make_eval_step",

@@ -1,0 +1,121 @@
+"""Pipeline parallelism: in-program microbatch pipelining over a mesh axis.
+
+The reference gets PP from vLLM/compiled-graphs with NCCL p2p channels
+(SURVEY.md §2.5: dag/compiled_dag_node.py:805 +
+experimental/channel/torch_tensor_nccl_channel.py:44 — actor pipelines
+stitched together at the Python layer). TPU-native PP is the opposite
+shape: the WHOLE pipeline is one jitted SPMD program over a `pipe` mesh
+axis; stage-to-stage transfer is a single-hop `lax.ppermute` over ICI,
+and the schedule is a compile-time loop — no framework in the inner
+loop, XLA overlaps each hop with the next microbatch's compute.
+
+Schedule: GPipe-style fill-drain over T = M + P - 1 ticks for M
+microbatches on P stages (the classic collective-permute pipeline).
+Each device holds ONE stage's params (params stacked on the pipe axis);
+at tick t, device p runs its stage on the microbatch that entered at
+t - p, then hands the activation to p+1.
+
+Combine with tensor/data axes freely: the stage_fn body may itself use
+`model`-axis sharded matmuls; the pipe axis only moves activations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,  # (M, mb, ...) on THIS device (replicated feed)
+    *,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Run inside shard_map. ``stage_params`` are the LOCAL stage's
+    params; ``microbatches`` is the full (M, ...) input (only stage 0
+    consumes it; other stages ignore their copy). Returns (M, ...)
+    outputs (only stage P-1's copy is meaningful; the sharded wrapper
+    broadcasts it back)."""
+    n = jax.lax.psum(1, axis_name)  # static
+    idx = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    T = M + n - 1
+
+    state = jnp.zeros(mb_shape, microbatches.dtype)  # current activation
+    outputs = jnp.zeros_like(microbatches)
+
+    def tick(t, carry):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (when in range)
+        feed_idx = jnp.clip(t, 0, M - 1)
+        fed = jnp.where(
+            idx == 0,
+            microbatches[feed_idx],
+            state,
+        )
+        out = stage_fn(stage_params, fed)
+        # last stage records its finished microbatch (entered at t-n+1)
+        out_idx = jnp.clip(t - (n - 1), 0, M - 1)
+        is_valid = jnp.logical_and(t - (n - 1) >= 0, t - (n - 1) <= M - 1)
+        outputs = jnp.where(
+            jnp.logical_and(idx == n - 1, is_valid),
+            outputs.at[out_idx].set(out),
+            outputs,
+        )
+        # hand activations downstream: p -> p+1 (last stage's output
+        # wraps to 0 but stage 0 overwrites it with the next feed)
+        state = jax.lax.ppermute(
+            out, axis_name, [(r, (r + 1) % n) for r in range(n)]
+        )
+        return state, outputs
+
+    state, outputs = jax.lax.fori_loop(0, T, tick, (state, outputs))
+    # broadcast final outputs from the last stage to all ranks so the
+    # wrapper can declare replicated out_specs
+    outputs = jax.lax.psum(
+        jnp.where(idx == n - 1, outputs, jnp.zeros_like(outputs)), axis_name
+    )
+    return outputs
+
+
+def pipeline_sharded(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,  # pytree with leading dim P (stacked per stage)
+    mesh: Mesh,
+    *,
+    axis_name: str = "pipe",
+    microbatch_size: Optional[int] = None,
+) -> Callable[[jax.Array], jax.Array]:
+    """Build a jit-ready pipelined forward: params' leading dim is
+    sharded over ``axis_name`` (one stage per mesh slot); input batch is
+    split into microbatches and streamed through the ring."""
+
+    def run(batch: jax.Array) -> jax.Array:
+        Btot = batch.shape[0]
+        mb = microbatch_size or max(1, Btot // mesh.shape[axis_name])
+        M = Btot // mb
+        micro = batch.reshape(M, mb, *batch.shape[1:])
+
+        def body(params_local, micro_local):
+            # params_local arrives with a leading stage dim of size 1
+            params_stage = jax.tree.map(lambda p: p[0], params_local)
+            return pipeline_apply(
+                stage_fn, params_stage, micro_local, axis_name=axis_name
+            )
+
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+        out = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            check_vma=False,
+        )(stacked_params, micro)
+        return out.reshape(Btot, *out.shape[2:])
+
+    return run

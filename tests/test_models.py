@@ -121,7 +121,22 @@ def test_flash_attention_in_model():
 
 def test_unknown_attention_impl_raises():
     import dataclasses
-    cfg = dataclasses.replace(llama.LLAMA_TINY, attention_impl="ring")
+    cfg = dataclasses.replace(llama.LLAMA_TINY, attention_impl="bogus")
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     with pytest.raises(ValueError, match="attention_impl"):
         llama.forward(params, jnp.zeros((1, 8), jnp.int32), cfg)
+
+
+def test_ring_attention_impl_matches_xla():
+    """attention_impl='ring' without a seq mesh falls back to flash and
+    matches the xla einsum path; with a seq mesh it runs the ring (the
+    multi-axis case is exercised by __graft_entry__.dryrun_multichip)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+    cfg_ring = dataclasses.replace(cfg, attention_impl="ring")
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    ref = llama.forward(params, toks, cfg)
+    out = llama.forward(params, toks, cfg_ring)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-3)

@@ -1,0 +1,219 @@
+"""Tests for parallelism ops: flash/ring/Ulysses attention, MoE,
+pipeline. All run on the virtual 8-device CPU mesh (conftest), the
+pattern SURVEY.md §4.5 calls out for testing collectives without
+accelerator fabric.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops import (
+    MoEConfig,
+    flash_attention,
+    init_moe_params,
+    moe_ffn,
+    ring_attention_sharded,
+    top_k_gating,
+    ulysses_attention,
+)
+from ray_tpu.parallel.pipeline import pipeline_sharded
+
+
+def naive_attention(q, k, v, causal=True):
+    B, S, H, hd = q.shape
+    KVH = k.shape[2]
+    G = H // KVH
+    qg = q.reshape(B, S, KVH, G, hd)
+    logits = jnp.einsum("bqkgh,btkh->bqkgt", qg, k) / np.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, k.shape[1]), bool))
+        logits = jnp.where(mask[None, :, None, None, :], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bqkgt,btkh->bqkgh", p, v)
+    return out.reshape(B, S, H, hd)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    B, S, H, KVH, hd = 2, 64, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, KVH, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, KVH, hd), jnp.float32)
+    return q, k, v
+
+
+class TestFlashAttention:
+    def test_matches_naive_causal(self, qkv):
+        q, k, v = qkv
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, causal=True, block_q=16, block_kv=16),
+            naive_attention(q, k, v, causal=True),
+            atol=1e-5,
+        )
+
+    def test_matches_naive_noncausal(self, qkv):
+        q, k, v = qkv
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, causal=False, block_q=16, block_kv=16),
+            naive_attention(q, k, v, causal=False),
+            atol=1e-5,
+        )
+
+    def test_mha_no_gqa(self):
+        B, S, H, hd = 1, 32, 2, 8
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(ks[0], (B, S, H, hd))
+        k = jax.random.normal(ks[1], (B, S, H, hd))
+        v = jax.random.normal(ks[2], (B, S, H, hd))
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, block_q=8, block_kv=8),
+            naive_attention(q, k, v),
+            atol=1e-5,
+        )
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("degree", [2, 4, 8])
+    def test_matches_flash(self, qkv, degree):
+        q, k, v = qkv
+        mesh = Mesh(np.asarray(jax.devices()[:degree]), ("seq",))
+        out = ring_attention_sharded(q, k, v, mesh, causal=True, block_q=16, block_kv=16)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(naive_attention(q, k, v)), atol=1e-4
+        )
+
+    def test_noncausal(self, qkv):
+        q, k, v = qkv
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("seq",))
+        out = ring_attention_sharded(q, k, v, mesh, causal=False, block_q=16, block_kv=16)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(naive_attention(q, k, v, causal=False)), atol=1e-4
+        )
+
+    def test_grad_flows(self, qkv):
+        q, k, v = qkv
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("seq",))
+
+        def loss(q):
+            return jnp.sum(ring_attention_sharded(q, k, v, mesh, block_q=16, block_kv=16) ** 2)
+
+        g = jax.grad(loss)(q)
+        assert jnp.isfinite(g).all()
+        ref = jax.grad(lambda q: jnp.sum(naive_attention(q, k, v) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref), atol=1e-3)
+
+
+class TestUlysses:
+    def test_matches_naive(self, qkv):
+        q, k, v = qkv
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("seq",))
+        fn = shard_map(
+            lambda a, b, c: ulysses_attention(a, b, c, causal=True),
+            mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(jax.jit(fn)(q, k, v)),
+            np.asarray(naive_attention(q, k, v)),
+            atol=1e-4,
+        )
+
+    def test_head_divisibility_enforced(self, qkv):
+        q, k, v = qkv  # KVH=2 < degree 4
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("seq",))
+        fn = shard_map(
+            lambda a, b, c: ulysses_attention(a, b, c),
+            mesh=mesh,
+            in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            jax.jit(fn)(q, k, v)
+
+
+class TestMoE:
+    def test_gating_capacity_and_loss(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (32, 4))
+        g = top_k_gating(logits, k=2, capacity_factor=1.0)
+        assert g.dispatch.shape == (32, 4, 16)
+        # every kept token appears exactly once per expert slot
+        assert float(g.dispatch.max()) <= 1.0
+        slot_usage = g.dispatch.sum(0)  # (E, C)
+        assert float(slot_usage.max()) <= 1.0 + 1e-6
+        assert jnp.isfinite(g.aux_loss)
+
+    def test_dense_equivalence_k_equals_e(self):
+        cfg = MoEConfig(d_model=16, d_ff=32, n_experts=2, k=2, capacity_factor=8.0)
+        p = init_moe_params(jax.random.PRNGKey(2), cfg, dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        out, _ = moe_ffn(p, x, cfg)
+        xt = x.reshape(-1, 16)
+        probs = jax.nn.softmax(xt @ p["router"], axis=-1)
+        dense = jnp.zeros_like(xt)
+        for e in range(2):
+            h = jax.nn.silu(xt @ p["w_gate"][e]) * (xt @ p["w_up"][e])
+            dense += probs[:, e : e + 1] * (h @ p["w_down"][e])
+        np.testing.assert_allclose(
+            np.asarray(out.reshape(-1, 16)), np.asarray(dense), atol=1e-4
+        )
+
+    def test_expert_parallel_sharding_compiles(self):
+        """moe params sharded on `expert` axis run under jit+mesh."""
+        from jax.sharding import NamedSharding
+
+        cfg = MoEConfig(d_model=16, d_ff=32, n_experts=4, k=2)
+        p = init_moe_params(jax.random.PRNGKey(0), cfg, dtype=jnp.float32)
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("expert",))
+        shard = NamedSharding(mesh, P("expert"))
+        p_sharded = {
+            k_: (jax.device_put(v_, shard) if v_.ndim == 3 else v_)
+            for k_, v_ in p.items()
+        }
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+        out, aux = jax.jit(lambda pp, xx: moe_ffn(pp, xx, cfg))(p_sharded, x)
+        ref, _ = moe_ffn(p, x, cfg)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        P_stages = 4
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+
+        def stage(params, x):
+            return jnp.tanh(x @ params["w"] + params["b"])
+
+        keys = jax.random.split(jax.random.PRNGKey(3), P_stages)
+        stacked = {
+            "w": jnp.stack([jax.random.normal(k_, (8, 8)) * 0.5 for k_ in keys]),
+            "b": jnp.zeros((P_stages, 8)),
+        }
+        batch = jax.random.normal(jax.random.PRNGKey(4), (16, 8))
+        out = jax.jit(pipeline_sharded(stage, stacked, mesh, microbatch_size=4))(batch)
+        ref = batch
+        for i in range(P_stages):
+            ref = stage({"w": stacked["w"][i], "b": stacked["b"][i]}, ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_single_microbatch(self):
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("pipe",))
+
+        def stage(params, x):
+            return x + params["c"]
+
+        stacked = {"c": jnp.asarray([1.0, 10.0])}
+        batch = jnp.zeros((4, 3))
+        out = jax.jit(
+            pipeline_sharded(
+                lambda p, x: stage(p, x), stacked, mesh, microbatch_size=4
+            )
+        )(batch)
+        np.testing.assert_allclose(np.asarray(out), np.full((4, 3), 11.0))
