@@ -49,6 +49,7 @@ class TrainWorker:
         latest_checkpoint_path: Optional[str],
         dataset_shards: Optional[Dict[str, Any]] = None,
         start_iteration: int = 0,
+        sync_reports: bool = False,
     ) -> None:
         from .._checkpoint import Checkpoint
         from ..session import TrainContext, _TrainSession, _init_session
@@ -71,6 +72,7 @@ class TrainWorker:
             latest_checkpoint=ckpt,
             dataset_shards=dataset_shards,
             start_iteration=start_iteration,
+            sync_reports=sync_reports,
         )
         _init_session(self.session)
 

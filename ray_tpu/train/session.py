@@ -70,10 +70,17 @@ class _TrainSession:
         latest_checkpoint: Optional[Checkpoint] = None,
         dataset_shards: Optional[Dict[str, Any]] = None,
         start_iteration: int = 0,
+        sync_reports: bool = False,
     ):
         self.context = context
         self.storage_dir = storage_dir
-        self.result_queue: "queue.Queue" = queue.Queue()
+        # sync mode (Tune trials): report() blocks until the controller
+        # drains — step-synchronized training, so schedulers (ASHA/PBT)
+        # can stop/exploit between iterations (the reference's function
+        # trainables block in session.report the same way)
+        self.result_queue: "queue.Queue" = queue.Queue(
+            maxsize=1 if sync_reports else 0
+        )
         self.latest_checkpoint = latest_checkpoint
         self.dataset_shards = dataset_shards or {}
         # Continues across gang restarts (controller passes the next

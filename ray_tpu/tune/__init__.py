@@ -1,0 +1,58 @@
+"""ray_tpu.tune — hyperparameter tuning.
+
+Parity: python/ray/tune/ (Tuner :43,312, TuneController, searchers,
+schedulers, sample space). tune.report/get_checkpoint are the Train
+session functions (the reference unified them the same way).
+"""
+
+from ..train.session import get_checkpoint, report
+from .sample import (
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from .schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    OptunaSearch,
+    Searcher,
+)
+from .tuner import ResultGrid, TuneConfig, Tuner, with_resources
+
+__all__ = [
+    "ASHAScheduler",
+    "BasicVariantGenerator",
+    "ConcurrencyLimiter",
+    "FIFOScheduler",
+    "OptunaSearch",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "sample_from",
+    "uniform",
+    "with_resources",
+]

@@ -1,0 +1,172 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Parity: python/ray/tune/schedulers/ (FIFOScheduler; ASHA
+async_hyperband.py — asynchronous successive halving with rungs; PBT
+pbt.py — exploit top quantile + explore by mutation).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str) -> None:
+        pass
+
+    # PBT hook: returns (source_trial_id, new_config) when the trial
+    # should exploit another, else None
+    def exploit(self, trial_id: str) -> Optional[tuple]:
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference:
+    tune/schedulers/async_hyperband.py AsyncHyperBandScheduler).
+
+    A trial reaching rung r (iteration = grace_period *
+    reduction_factor^r) continues only if its metric is in the top
+    1/reduction_factor of results recorded at that rung.
+    """
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung iteration -> list of recorded metric values
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+        # trial -> rung levels it has already been evaluated at
+        self._recorded: Dict[str, set] = defaultdict(set)
+        self._sign = -1.0 if mode == "min" else 1.0
+
+    def _rung_levels(self) -> List[int]:
+        levels = []
+        t = self.grace
+        while t < self.max_t:
+            levels.append(t)
+            t *= self.rf
+        return levels
+
+    def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
+        t = metrics.get(self.time_attr, 0)
+        val = metrics.get(self.metric)
+        if val is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        # evaluate at every rung level CROSSED since the last report —
+        # time_attr need not land exactly on grace * rf^r (a trial
+        # reporting at t=1000, 2000, ... still hits rungs 1, 4, 16, ...)
+        for level in self._rung_levels():
+            if t >= level and level not in self._recorded[trial_id]:
+                self._recorded[trial_id].add(level)
+                rung = self._rungs[level]
+                rung.append(self._sign * float(val))
+                k = max(1, len(rung) // self.rf)
+                cutoff = sorted(rung, reverse=True)[k - 1]
+                if self._sign * float(val) < cutoff:
+                    return STOP
+        return CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): every
+    ``perturbation_interval`` iterations, bottom-quantile trials clone
+    the state of a top-quantile trial (checkpoint exploit) and mutate
+    its hyperparameters (explore)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        time_attr: str = "training_iteration",
+        seed: Optional[int] = None,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self._sign = -1.0 if mode == "min" else 1.0
+        self._latest: Dict[str, Dict[str, Any]] = {}
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._last_perturb: Dict[str, int] = {}
+        self._rng = random.Random(seed)
+
+    def register_config(self, trial_id: str, config: Dict[str, Any]) -> None:
+        self._configs[trial_id] = dict(config)
+
+    def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
+        self._latest[trial_id] = dict(metrics)
+        return CONTINUE
+
+    def exploit(self, trial_id: str) -> Optional[tuple]:
+        m = self._latest.get(trial_id)
+        if not m or self.metric not in m:
+            return None
+        t = m.get(self.time_attr, 0)
+        if t - self._last_perturb.get(trial_id, 0) < self.interval:
+            return None
+        scores = {
+            tid: self._sign * float(mm[self.metric])
+            for tid, mm in self._latest.items()
+            if self.metric in mm
+        }
+        if len(scores) < 2:
+            return None
+        ranked = sorted(scores, key=scores.get, reverse=True)
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        top, bottom = ranked[:k], ranked[n - k :]
+        if trial_id not in bottom or trial_id in top:
+            self._last_perturb[trial_id] = t
+            return None
+        source = self._rng.choice(top)
+        new_config = self._mutate(self._configs.get(source, {}))
+        self._last_perturb[trial_id] = t
+        self._configs[trial_id] = new_config
+        return source, new_config
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from .sample import Domain
+
+        out = dict(config)
+        for k, spec in self.mutations.items():
+            if isinstance(spec, Domain):
+                out[k] = spec.sample(self._rng)
+            elif isinstance(spec, list):
+                out[k] = self._rng.choice(spec)
+            elif callable(spec):
+                out[k] = spec()
+            elif k in out and isinstance(out[k], (int, float)):
+                factor = self._rng.choice([0.8, 1.2])
+                out[k] = type(out[k])(out[k] * factor)
+        return out

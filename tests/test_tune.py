@@ -1,0 +1,169 @@
+"""Tune tests (pattern: python/ray/tune/tests/ — tiny function
+trainables on a real runtime; scheduler/searcher behavioral asserts)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, RunConfig
+from ray_tpu.tune import (
+    ASHAScheduler,
+    PopulationBasedTraining,
+    TuneConfig,
+    Tuner,
+)
+
+
+@pytest.fixture
+def storage(tmp_path):
+    return str(tmp_path / "tune")
+
+
+def test_grid_search_runs_all(ray_start_4_cpus, storage):
+    def trainable(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search([0, 1])},
+        tune_config=TuneConfig(metric="score", mode="max", max_concurrent_trials=2),
+        run_config=RunConfig(name="grid", storage_path=storage),
+    )
+    results = tuner.fit()
+    assert len(results) == 6
+    best = results.get_best_result()
+    assert best.metrics["score"] == 31
+    assert best.metrics["config"] == {"a": 3, "b": 1}
+
+
+def test_random_sampling(ray_start_4_cpus, storage):
+    def trainable(config):
+        tune.report({"v": config["lr"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=TuneConfig(metric="v", mode="min", num_samples=4, seed=7),
+        run_config=RunConfig(name="rand", storage_path=storage),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    vals = [r.metrics["v"] for r in results]
+    assert all(1e-5 <= v <= 1e-1 for v in vals)
+    assert len(set(vals)) > 1  # actually sampled
+
+
+def test_trial_error_isolated(ray_start_4_cpus, storage):
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("trial poisoned")
+        tune.report({"ok": config["x"]})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="ok", mode="max"),
+        run_config=RunConfig(name="err", storage_path=storage),
+    ).fit()
+    assert len(results) == 3
+    assert len(results.errors) == 1
+    assert results.get_best_result().metrics["ok"] == 2
+
+
+def test_asha_stops_bad_trials(ray_start_4_cpus, storage):
+    def trainable(config):
+        for i in range(20):
+            # bad trials plateau high; good trials descend
+            loss = config["base"] - (i * 0.1 if config["base"] < 5 else 0.0)
+            tune.report({"loss": loss})
+
+    sched = ASHAScheduler(metric="loss", mode="min", max_t=20, grace_period=2, reduction_factor=2)
+    results = Tuner(
+        trainable,
+        param_space={"base": tune.grid_search([1.0, 2.0, 8.0, 9.0])},
+        tune_config=TuneConfig(metric="loss", mode="min", scheduler=sched,
+                               max_concurrent_trials=4),
+        run_config=RunConfig(name="asha", storage_path=storage),
+    ).fit()
+    assert len(results) == 4
+    # the bad trials must have been stopped before finishing 20 iters
+    iters = {r.metrics["config"]["base"]: r.metrics["training_iteration"] for r in results}
+    assert iters[8.0] < 20 or iters[9.0] < 20
+    assert results.get_best_result().metrics["config"]["base"] == 1.0
+
+
+def test_checkpointed_trials(ray_start_4_cpus, storage):
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_state()["i"] + 1 if ckpt else 0
+        for i in range(start, 3):
+            tune.report({"i": i}, checkpoint=Checkpoint.from_state({"i": i}))
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0])},
+        tune_config=TuneConfig(metric="i", mode="max"),
+        run_config=RunConfig(name="ckpt", storage_path=storage),
+    ).fit()
+    r = results[0]
+    assert r.checkpoint is not None
+    assert r.checkpoint.to_state()["i"] == 2
+
+
+def test_pbt_exploits(ray_start_4_cpus, storage):
+    """Bottom trial adopts top trial's checkpoint + mutated config."""
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        level = ckpt.to_state()["level"] if ckpt else 0.0
+        for i in range(12):
+            level += config["rate"]
+            tune.report(
+                {"score": level},
+                checkpoint=Checkpoint.from_state({"level": level}),
+            )
+
+    sched = PopulationBasedTraining(
+        metric="score",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_mutations={"rate": tune.uniform(0.5, 2.0)},
+        quantile_fraction=0.5,
+        seed=3,
+    )
+    results = Tuner(
+        trainable,
+        param_space={"rate": tune.grid_search([0.01, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=sched,
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="pbt", storage_path=storage),
+    ).fit()
+    best = results.get_best_result()
+    # the slow trial exploited the fast one, so both finish far above
+    # what rate=0.01 alone could reach (12 * 0.01 = 0.12)
+    scores = sorted(r.metrics["score"] for r in results)
+    assert scores[0] > 1.0
+
+
+def test_tuner_wraps_trainer(ray_start_4_cpus, storage):
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+
+        train.report({"out": config["m"] * 2})
+
+    trainer = DataParallelTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="inner", storage_path=storage),
+    )
+    results = Tuner(
+        trainer,
+        param_space={"m": tune.grid_search([3, 5])},
+        tune_config=TuneConfig(metric="out", mode="max", max_concurrent_trials=1),
+        run_config=RunConfig(name="wrap", storage_path=storage),
+    ).fit()
+    assert results.get_best_result().metrics["out"] == 10
