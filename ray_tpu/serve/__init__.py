@@ -1,0 +1,262 @@
+"""ray_tpu.serve — model serving.
+
+Parity: python/ray/serve/ (api.py:591 serve.run; @serve.deployment;
+DeploymentHandle composition; @serve.batch; controller/replica/proxy
+architecture §3.6). TPU angle: replicas with ``num_tpus`` pin chips for
+their lifetime so jitted models stay compiled+resident, and
+@serve.batch feeds them MXU-sized batches.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from .batching import batch
+from .handle import DeploymentHandle, DeploymentResponse
+from ._private.controller import CONTROLLER_NAME, DeploymentInfo, ServeController
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]
+
+
+@dataclass
+class Application:
+    """A bound deployment graph node (parity: serve.Application from
+    Deployment.bind)."""
+
+    deployment: "Deployment"
+    args: tuple
+    kwargs: dict
+
+
+class Deployment:
+    def __init__(
+        self,
+        func_or_class: Any,
+        name: str,
+        *,
+        num_replicas: Union[int, str, None] = None,
+        max_ongoing_requests: int = 16,
+        ray_actor_options: Optional[Dict[str, Any]] = None,
+        user_config: Any = None,
+        autoscaling_config: Optional[Dict[str, Any]] = None,
+    ):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.num_replicas = num_replicas
+        self.max_ongoing_requests = max_ongoing_requests
+        self.ray_actor_options = ray_actor_options or {}
+        self.user_config = user_config
+        self.autoscaling_config = autoscaling_config
+
+    def options(self, **opts) -> "Deployment":
+        merged = {
+            "num_replicas": self.num_replicas,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "ray_actor_options": self.ray_actor_options,
+            "user_config": self.user_config,
+            "autoscaling_config": self.autoscaling_config,
+        }
+        name = opts.pop("name", self.name)
+        merged.update(opts)
+        return Deployment(self.func_or_class, name, **merged)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **k):
+        raise RuntimeError(
+            f"Deployment {self.name!r} cannot be called directly; "
+            "use .bind() + serve.run, then handle.remote()"
+        )
+
+
+def deployment(
+    _func_or_class: Optional[Any] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Union[int, str, None] = None,
+    max_ongoing_requests: int = 16,
+    ray_actor_options: Optional[Dict[str, Any]] = None,
+    user_config: Any = None,
+    autoscaling_config: Optional[Dict[str, Any]] = None,
+):
+    """@serve.deployment decorator (reference: serve/api.py)."""
+
+    def wrap(target):
+        return Deployment(
+            target,
+            name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            ray_actor_options=ray_actor_options,
+            user_config=user_config,
+            autoscaling_config=autoscaling_config,
+        )
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+# ---------------------------------------------------------------- control
+
+
+def _get_or_start_controller():
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        ctrl_cls = ray_tpu.remote(ServeController)
+        try:
+            return ctrl_cls.options(
+                name=CONTROLLER_NAME, lifetime="detached", max_concurrency=16,
+                num_cpus=0.1,
+            ).remote()
+        except Exception:
+            return ray_tpu.get_actor(CONTROLLER_NAME)
+
+
+_proxy = None
+
+
+def start(*, http_options: Optional[Dict[str, Any]] = None, proxy: bool = False):
+    """Start serve system actors (reference: serve.start). The HTTP
+    proxy starts on demand (serve.run(..., route_prefix=...) or
+    proxy=True)."""
+    global _proxy
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    controller = _get_or_start_controller()
+    if proxy and _proxy is None:
+        opts = http_options or {}
+        proxy_cls = ray_tpu.remote(
+            __import__(
+                "ray_tpu.serve._private.proxy", fromlist=["HTTPProxy"]
+            ).HTTPProxy
+        )
+        _proxy = proxy_cls.options(max_concurrency=64, num_cpus=0.1).remote(
+            opts.get("host", "127.0.0.1"), opts.get("port", 8000)
+        )
+        ray_tpu.get(_proxy.ping.remote())
+    return controller
+
+
+def _collect_deployments(app: Application, out: Dict[str, DeploymentInfo], route_prefix):
+    """DFS the bound graph: child Applications in args become
+    DeploymentHandles (model composition)."""
+
+    def convert(v):
+        if isinstance(v, Application):
+            _collect_deployments(v, out, None)
+            return DeploymentHandle(v.deployment.name)
+        return v
+
+    args = tuple(convert(a) for a in app.args)
+    kwargs = {k: convert(v) for k, v in app.kwargs.items()}
+    d = app.deployment
+    num = d.num_replicas
+    if num in (None, "auto"):
+        num = (d.autoscaling_config or {}).get("min_replicas", 1)
+    out[d.name] = DeploymentInfo(
+        name=d.name,
+        cls=d.func_or_class,
+        init_args=args,
+        init_kwargs=kwargs,
+        num_replicas=int(num),
+        max_ongoing_requests=d.max_ongoing_requests,
+        ray_actor_options=d.ray_actor_options,
+        user_config=d.user_config,
+        autoscaling_config=d.autoscaling_config,
+        route_prefix=route_prefix,
+    )
+
+
+def run(
+    app: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = None,
+    blocking: bool = False,
+    _http: bool = False,
+    http_options: Optional[Dict[str, Any]] = None,
+) -> DeploymentHandle:
+    """Deploy an application; returns the ingress deployment's handle
+    (reference: serve.run, api.py:591)."""
+    import ray_tpu
+
+    controller = start(proxy=_http or route_prefix is not None, http_options=http_options)
+    infos: Dict[str, DeploymentInfo] = {}
+    _collect_deployments(app, infos, route_prefix)
+    for info in infos.values():
+        ray_tpu.get(controller.deploy.remote(info))
+    # wait until every deployment has live replicas
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ray_tpu.get(controller.ready.remote()):
+            break
+        time.sleep(0.05)
+    handle = DeploymentHandle(app.deployment.name)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        return {"applications": {}}
+    return {"applications": ray_tpu.get(controller.list_deployments.remote())}
+
+
+def delete(name: str) -> None:
+    import ray_tpu
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def shutdown() -> None:
+    global _proxy
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.shutdown.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    if _proxy is not None:
+        try:
+            ray_tpu.kill(_proxy)
+        except Exception:
+            pass
+        _proxy = None

@@ -1,0 +1,221 @@
+"""ServeController: the reconcile loop.
+
+Parity: python/ray/serve/_private/controller.py:86 + deployment_state.py
+— a singleton named actor holding target state {deployment -> config},
+reconciling replica actors toward it, running autoscaling, and serving
+discovery (the reference broadcasts routing tables via LongPollHost; on
+the single-host runtime handles pull the replica list and refresh on
+miss/failure, which has the same eventual-consistency semantics without
+the long-poll machinery).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "__serve_controller"
+_RECONCILE_PERIOD_S = 0.25
+
+
+@dataclass
+class DeploymentInfo:
+    name: str
+    cls: Any
+    init_args: tuple
+    init_kwargs: dict
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    user_config: Any = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    route_prefix: Optional[str] = None
+    version: int = 0
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, DeploymentInfo] = {}
+        self._replicas: Dict[str, List[Any]] = {}  # name -> actor handles
+        self._replica_versions: Dict[str, List[int]] = {}
+        self._lock = threading.RLock()
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile"
+        )
+        self._thread.start()
+
+    # -- API (called by serve.run / handles / proxy) -------------------
+    def deploy(self, info: DeploymentInfo) -> None:
+        with self._lock:
+            prev = self._deployments.get(info.name)
+            info.version = (prev.version + 1) if prev else 0
+            self._deployments[info.name] = info
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            self._deployments.pop(name, None)
+
+    def get_replicas(self, name: str) -> List[Any]:
+        with self._lock:
+            return list(self._replicas.get(name, []))
+
+    def list_deployments(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                n: {
+                    "num_replicas": d.num_replicas,
+                    "live_replicas": len(self._replicas.get(n, [])),
+                    "route_prefix": d.route_prefix,
+                    "version": d.version,
+                }
+                for n, d in self._deployments.items()
+            }
+
+    def get_routes(self) -> Dict[str, str]:
+        with self._lock:
+            return {
+                d.route_prefix: n
+                for n, d in self._deployments.items()
+                if d.route_prefix
+            }
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            names = list(self._deployments)
+            self._deployments.clear()
+        for name in names:
+            self._scale_to(name, None, 0)
+
+    def ready(self) -> bool:
+        """True when every deployment has its target replica count."""
+        with self._lock:
+            return all(
+                len(self._replicas.get(n, [])) >= d.num_replicas
+                for n, d in self._deployments.items()
+            )
+
+    # -- reconcile ----------------------------------------------------
+    def _reconcile_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile_once()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            self._autoscale()
+            self._shutdown.wait(_RECONCILE_PERIOD_S)
+
+    def _reconcile_once(self) -> None:
+        import ray_tpu
+
+        with self._lock:
+            targets = dict(self._deployments)
+        for name, info in targets.items():
+            live = self._replicas.get(name, [])
+            versions = self._replica_versions.get(name, [])
+            # drop dead replicas (ping via queue_len)
+            alive, alive_vers = [], []
+            for actor, ver in zip(live, versions):
+                try:
+                    ray_tpu.get(actor.queue_len.remote(), timeout=5.0)
+                except Exception:
+                    continue
+                # version bump (redeploy): retire old-code replicas
+                if ver == info.version:
+                    alive.append(actor)
+                    alive_vers.append(ver)
+                else:
+                    try:
+                        ray_tpu.kill(actor)
+                    except Exception:
+                        pass
+            while len(alive) < info.num_replicas:
+                actor = self._start_replica(info)
+                alive.append(actor)
+                alive_vers.append(info.version)
+            while len(alive) > info.num_replicas:
+                victim = alive.pop()
+                alive_vers.pop()
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
+            with self._lock:
+                self._replicas[name] = alive
+                self._replica_versions[name] = alive_vers
+        # GC deleted deployments
+        with self._lock:
+            for name in list(self._replicas):
+                if name not in targets:
+                    self._scale_to(name, None, 0)
+
+    def _start_replica(self, info: DeploymentInfo):
+        import ray_tpu
+        from .replica import Replica
+
+        opts = dict(info.ray_actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        opts["max_concurrency"] = max(2, info.max_ongoing_requests)
+        replica_cls = ray_tpu.remote(Replica)
+        actor = replica_cls.options(**opts).remote(
+            info.name, info.cls, info.init_args, info.init_kwargs, info.user_config
+        )
+        return actor
+
+    def _scale_to(self, name: str, info, n: int) -> None:
+        import ray_tpu
+
+        with self._lock:
+            live = self._replicas.get(name, [])
+            keep, drop = live[:n], live[n:]
+            if n == 0:
+                self._replicas.pop(name, None)
+                self._replica_versions.pop(name, None)
+            else:
+                self._replicas[name] = keep
+                self._replica_versions[name] = self._replica_versions.get(name, [])[:n]
+        for actor in drop:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+
+    # -- autoscaling ---------------------------------------------------
+    def _autoscale(self) -> None:
+        """Target-ongoing-requests autoscaling (reference:
+        serve/_private/autoscaling_state.py + autoscaling_policy.py:
+        desired = ceil(total_ongoing / target_per_replica), clamped)."""
+        import math
+
+        import ray_tpu
+
+        with self._lock:
+            targets = {
+                n: d for n, d in self._deployments.items() if d.autoscaling_config
+            }
+        for name, info in targets.items():
+            cfg = info.autoscaling_config
+            replicas = self.get_replicas(name)
+            if not replicas:
+                continue
+            try:
+                loads = ray_tpu.get(
+                    [r.queue_len.remote() for r in replicas], timeout=5.0
+                )
+            except Exception:
+                continue
+            total = sum(loads)
+            target_per = cfg.get("target_ongoing_requests", 2)
+            desired = max(1, math.ceil(total / max(target_per, 1e-9)))
+            desired = min(
+                cfg.get("max_replicas", 1), max(cfg.get("min_replicas", 1), desired)
+            )
+            if desired != info.num_replicas:
+                with self._lock:
+                    if name in self._deployments:
+                        self._deployments[name].num_replicas = desired

@@ -1,0 +1,116 @@
+"""HTTP proxy actor: aiohttp ingress routing to deployments.
+
+Parity: python/ray/serve/_private/proxy.py (uvicorn there; aiohttp
+here — it's what the environment ships, and it's the reference's own
+dashboard HTTP stack) + proxy_router.py longest-prefix route matching.
+The request reaches the app as a dict {method, path, query, body,
+headers}; the deployment's return value is JSON-encoded (bytes/str pass
+through).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self.host = host
+        self.port = port
+        self._routes: Dict[str, str] = {}
+        self._routes_refreshed = float("-inf")
+        self._handles: Dict[str, Any] = {}
+        self._loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve_forever, daemon=True, name="serve-http"
+        )
+        self._thread.start()
+        self._ready.wait(timeout=10)
+
+    def _serve_forever(self):
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._start())
+        self._loop.run_forever()
+
+    async def _start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        self._ready.set()
+
+    def update_routes(self, routes: Dict[str, str]) -> None:
+        self._routes = dict(routes)
+
+    def ping(self) -> bool:
+        return self._ready.is_set()
+
+    def _match(self, path: str) -> Optional[str]:
+        best = None
+        for prefix, name in self._routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        return best[1] if best else None
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        import time as _time
+
+        # periodic cached refresh, off the event loop (a controller
+        # stall must not freeze unrelated in-flight requests)
+        if _time.monotonic() - self._routes_refreshed > 1.0:
+            self._routes_refreshed = _time.monotonic()
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._refresh_routes
+            )
+        name = self._match(request.path)
+        if name is None:
+            return web.Response(status=404, text="no deployment matches path")
+        handle = self._handles.get(name)
+        if handle is None:
+            from ..handle import DeploymentHandle
+
+            handle = DeploymentHandle(name)
+            self._handles[name] = handle
+        body = await request.read()
+        req = {
+            "method": request.method,
+            "path": request.path,
+            "query": dict(request.query),
+            "body": body,
+            "headers": dict(request.headers),
+        }
+        try:
+            # routing involves blocking control-plane calls; keep the
+            # event loop free by doing route+wait on a worker thread
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: handle.remote(req).result(timeout_s=60)
+            )
+        except Exception as e:
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if isinstance(result, (bytes, bytearray)):
+            return web.Response(body=bytes(result))
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result)
+
+    def _refresh_routes(self) -> None:
+        try:
+            import ray_tpu
+
+            from .controller import CONTROLLER_NAME
+
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            self._routes = ray_tpu.get(controller.get_routes.remote())
+        except Exception:
+            pass

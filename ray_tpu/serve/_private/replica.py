@@ -1,0 +1,96 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Parity: python/ray/serve/_private/replica.py — wraps the user class,
+counts ongoing requests (the router's load signal), health checks,
+graceful reconfigure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+
+class Replica:
+    def __init__(
+        self,
+        deployment_name: str,
+        serialized_cls,  # the user class (cloudpickled through task args)
+        init_args: Tuple,
+        init_kwargs: Dict[str, Any],
+        user_config: Any = None,
+    ):
+        self.deployment_name = deployment_name
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._total = 0
+        cls = serialized_cls
+        if callable(cls) and not inspect.isclass(cls):
+            # function deployment: wrap into a callable object
+            fn = cls
+
+            class _FnWrapper:
+                def __call__(self, *a, **k):
+                    return fn(*a, **k)
+
+            self.instance = _FnWrapper()
+        else:
+            self.instance = cls(*init_args, **init_kwargs)
+        if user_config is not None and hasattr(self.instance, "reconfigure"):
+            self.instance.reconfigure(user_config)
+
+    # -- introspection (router load probes, controller health checks) --
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def check_health(self) -> bool:
+        fn = getattr(self.instance, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+    def reconfigure(self, user_config: Any) -> None:
+        if hasattr(self.instance, "reconfigure"):
+            self.instance.reconfigure(user_config)
+
+    # -- request path --------------------------------------------------
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = (
+                self.instance
+                if method_name == "__call__"
+                else getattr(self.instance, method_name)
+            )
+            result = target(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = _run_coro(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+
+_loop: Optional[asyncio.AbstractEventLoop] = None
+_loop_lock = threading.Lock()
+
+
+def _run_coro(coro):
+    """Run a coroutine from sync context on a persistent loop (user
+    callables may be async — e.g. @serve.batch methods)."""
+    global _loop
+    with _loop_lock:
+        if _loop is None:
+            _loop = asyncio.new_event_loop()
+            t = threading.Thread(target=_loop.run_forever, daemon=True, name="replica-aio")
+            t.start()
+    fut = asyncio.run_coroutine_threadsafe(coro, _loop)
+    return fut.result()

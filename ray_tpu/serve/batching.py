@@ -1,0 +1,103 @@
+"""@serve.batch — transparent request batching.
+
+Parity: python/ray/serve/batching.py — an async method decorated with
+@serve.batch collects concurrent calls into a list and invokes the
+underlying function once per batch (max_batch_size or
+batch_wait_timeout_s, whichever first). On TPU replicas this is the
+lever that turns scalar requests into MXU-sized batches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_s
+        self.queue: Optional[asyncio.Queue] = None
+        self.task: Optional[asyncio.Task] = None
+
+    def _ensure(self):
+        if self.queue is None:
+            self.queue = asyncio.Queue()
+            self.task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def submit(self, item) -> Any:
+        self._ensure()
+        fut = asyncio.get_running_loop().create_future()
+        await self.queue.put((item, fut))
+        return await fut
+
+    async def _loop(self):
+        while True:
+            item, fut = await self.queue.get()
+            batch = [(item, fut)]
+            deadline = asyncio.get_running_loop().time() + self.timeout_s
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(
+                        await asyncio.wait_for(self.queue.get(), timeout=remaining)
+                    )
+                except asyncio.TimeoutError:
+                    break
+            items = [b[0] for b in batch]
+            futs = [b[1] for b in batch]
+            try:
+                results = await self.fn(items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function returned {len(results)} results "
+                        f"for a batch of {len(items)}"
+                    )
+                for f, r in zip(futs, results):
+                    if not f.done():
+                        f.set_result(r)
+            except Exception as e:
+                for f in futs:
+                    if not f.done():
+                        f.set_exception(e)
+
+
+def batch(
+    _fn: Optional[Callable] = None,
+    *,
+    max_batch_size: int = 10,
+    batch_wait_timeout_s: float = 0.01,
+):
+    """Decorator: async def method(self, items: List[T]) -> List[R]
+    becomes callable with a single item."""
+
+    def wrap(fn):
+        attr = f"__batch_queue_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def wrapper(self_or_item, *args):
+            # methods: first arg is self; functions: first arg is the item
+            if args:
+                self, item = self_or_item, args[0]
+                bound = functools.partial(fn, self)
+                holder = self
+            else:
+                item = self_or_item
+                bound = fn
+                holder = wrapper
+            q = getattr(holder, attr, None)
+            if q is None:
+                q = _BatchQueue(bound, max_batch_size, batch_wait_timeout_s)
+                setattr(holder, attr, q)
+            return await q.submit(item)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

@@ -1,0 +1,173 @@
+"""DeploymentHandle: the caller-side router.
+
+Parity: python/ray/serve/handle.py + _private/router.py:321 +
+replica_scheduler/pow_2_scheduler.py:52 — requests route to the replica
+with the shorter queue among two random choices (power of two choices),
+tracked by caller-side outstanding counts and corrected by periodic
+replica-list refresh. ``.remote()`` returns a DeploymentResponse future
+(composable: passing a response as an argument chains on its result).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_REFRESH_PERIOD_S = 1.0
+
+
+def _rid(replica) -> bytes:
+    """Stable identity of a replica actor across handle refreshes."""
+    return replica._actor_id.binary()
+
+
+class DeploymentResponse:
+    """Future for one request (parity: serve.handle.DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __await__(self):
+        return self._ref.__await__()
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.method_name = method_name
+        self._replicas: List[Any] = []
+        self._outstanding: Dict[int, int] = {}
+        self._inflight: Dict[Any, int] = {}  # ref -> replica id
+        self._refreshed = 0.0
+        self._lock = threading.Lock()
+
+    def __reduce__(self):
+        # handles travel inside deployment init args (composition);
+        # router state is per-process and rebuilt on first use
+        return (DeploymentHandle, (self.deployment_name, self.method_name))
+
+    # -- API -----------------------------------------------------------
+    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, method_name or self.method_name)
+        h._replicas = self._replicas
+        h._outstanding = self._outstanding
+        h._refreshed = self._refreshed
+        return h
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._route(self.method_name, args, kwargs)
+
+    # -- routing -------------------------------------------------------
+    def _controller(self):
+        import ray_tpu
+
+        from ._private.controller import CONTROLLER_NAME
+
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._refreshed < _REFRESH_PERIOD_S and self._replicas:
+                return
+            self._refreshed = now
+        import ray_tpu
+
+        replicas = ray_tpu.get(
+            self._controller().get_replicas.remote(self.deployment_name)
+        )
+        with self._lock:
+            self._replicas = replicas
+            # keyed by the STABLE actor id — ActorHandle objects are
+            # re-created on every refresh deserialization, so id() keys
+            # would zero the load accounting each second
+            self._outstanding = {
+                _rid(r): self._outstanding.get(_rid(r), 0) for r in replicas
+            }
+
+    def _route(self, method: str, args, kwargs) -> DeploymentResponse:
+        # unwrap composed responses: pass the underlying ref so the
+        # downstream replica receives the resolved value (model
+        # composition, reference handle.py DeploymentResponse chaining)
+        args = tuple(
+            a._to_object_ref() if isinstance(a, DeploymentResponse) else a
+            for a in args
+        )
+        kwargs = {
+            k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        deadline = time.monotonic() + 30.0
+        while True:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no live replicas for deployment "
+                    f"{self.deployment_name!r} after 30s"
+                )
+            time.sleep(0.05)
+        self._reconcile_inflight()
+        replica = self._pick(replicas)
+        rid = _rid(replica)
+        with self._lock:
+            self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
+        ref = replica.handle_request.remote(method, args, kwargs)
+        with self._lock:
+            self._inflight[ref] = rid
+        return DeploymentResponse(ref)
+
+    def _pick(self, replicas: List[Any]):
+        """Power-of-two-choices on caller-side outstanding counts."""
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        with self._lock:
+            la = self._outstanding.get(_rid(a), 0)
+            lb = self._outstanding.get(_rid(b), 0)
+        return a if la <= lb else b
+
+    def _reconcile_inflight(self) -> None:
+        """Lazily credit finished requests back to their replicas (a
+        zero-timeout wait on the next route, instead of a watcher thread
+        per request — the reference likewise folds completion accounting
+        into the router's request path)."""
+        import ray_tpu
+
+        with self._lock:
+            refs = list(self._inflight.keys())
+        if not refs:
+            return
+        done, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=0)
+        with self._lock:
+            for ref in done:
+                rid = self._inflight.pop(ref, None)
+                if rid is not None and self._outstanding.get(rid, 0) > 0:
+                    self._outstanding[rid] -= 1
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._route(self._method, args, kwargs)

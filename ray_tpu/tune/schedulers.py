@@ -17,6 +17,21 @@ STOP = "STOP"
 
 
 class TrialScheduler:
+    metric: Optional[str] = None
+    mode: Optional[str] = None
+
+    @property
+    def _sign(self) -> float:
+        return -1.0 if (self.mode or "min") == "min" else 1.0
+
+    def set_metric_and_mode(self, metric: Optional[str], mode: Optional[str]) -> None:
+        """Fill UNSET metric/mode from TuneConfig (controller calls this
+        before launching trials); explicit scheduler args win."""
+        if self.metric is None and metric:
+            self.metric = metric
+        if self.mode is None and mode:
+            self.mode = mode
+
     def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
         return CONTINUE
 
@@ -44,13 +59,15 @@ class ASHAScheduler(TrialScheduler):
 
     def __init__(
         self,
-        metric: str = "loss",
-        mode: str = "min",
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
         max_t: int = 100,
         grace_period: int = 1,
         reduction_factor: int = 4,
         time_attr: str = "training_iteration",
     ):
+        # metric/mode may be deferred to TuneConfig (resolved by the
+        # controller via set_metric_and_mode before the run starts)
         self.metric = metric
         self.mode = mode
         self.max_t = max_t
@@ -61,7 +78,6 @@ class ASHAScheduler(TrialScheduler):
         self._rungs: Dict[int, List[float]] = defaultdict(list)
         # trial -> rung levels it has already been evaluated at
         self._recorded: Dict[str, set] = defaultdict(set)
-        self._sign = -1.0 if mode == "min" else 1.0
 
     def _rung_levels(self) -> List[int]:
         levels = []
@@ -73,7 +89,7 @@ class ASHAScheduler(TrialScheduler):
 
     def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
         t = metrics.get(self.time_attr, 0)
-        val = metrics.get(self.metric)
+        val = metrics.get(self.metric) if self.metric else None
         if val is None:
             return CONTINUE
         if t >= self.max_t:
@@ -101,8 +117,8 @@ class PopulationBasedTraining(TrialScheduler):
 
     def __init__(
         self,
-        metric: str = "loss",
-        mode: str = "min",
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
         perturbation_interval: int = 5,
         hyperparam_mutations: Optional[Dict[str, Any]] = None,
         quantile_fraction: float = 0.25,
@@ -115,7 +131,6 @@ class PopulationBasedTraining(TrialScheduler):
         self.mutations = hyperparam_mutations or {}
         self.quantile = quantile_fraction
         self.time_attr = time_attr
-        self._sign = -1.0 if mode == "min" else 1.0
         self._latest: Dict[str, Dict[str, Any]] = {}
         self._configs: Dict[str, Dict[str, Any]] = {}
         self._last_perturb: Dict[str, int] = {}
@@ -130,7 +145,7 @@ class PopulationBasedTraining(TrialScheduler):
 
     def exploit(self, trial_id: str) -> Optional[tuple]:
         m = self._latest.get(trial_id)
-        if not m or self.metric not in m:
+        if not m or not self.metric or self.metric not in m:
             return None
         t = m.get(self.time_attr, 0)
         if t - self._last_perturb.get(trial_id, 0) < self.interval:
@@ -151,9 +166,16 @@ class PopulationBasedTraining(TrialScheduler):
             return None
         source = self._rng.choice(top)
         new_config = self._mutate(self._configs.get(source, {}))
-        self._last_perturb[trial_id] = t
-        self._configs[trial_id] = new_config
+        # NOT committed yet: the controller confirms via commit_exploit
+        # only after the restart-from-checkpoint actually happens, so a
+        # skipped exploit (source has no checkpoint yet) leaves this
+        # trial's population record truthful.
         return source, new_config
+
+    def commit_exploit(self, trial_id: str, new_config: Dict[str, Any]) -> None:
+        t = self._latest.get(trial_id, {}).get(self.time_attr, 0)
+        self._last_perturb[trial_id] = t
+        self._configs[trial_id] = dict(new_config)
 
     def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
         from .sample import Domain

@@ -108,6 +108,21 @@ class OptunaSearch(Searcher):
         self._study = optuna.create_study(
             direction="minimize" if mode == "min" else "maximize", sampler=sampler
         )
+        from .sample import Categorical, Domain, Float, Integer
+
+        for k, v in param_space.items():
+            if isinstance(v, Domain) and not isinstance(
+                v, (Float, Integer, Categorical)
+            ):
+                raise ValueError(
+                    f"OptunaSearch supports uniform/loguniform/randint/choice "
+                    f"domains; param {k!r} is {type(v).__name__}"
+                )
+            if isinstance(v, dict):
+                raise ValueError(
+                    f"OptunaSearch does not support nested search spaces "
+                    f"(param {k!r}); flatten the space"
+                )
         self.param_space = param_space
         self._trials: Dict[str, Any] = {}
 

@@ -133,8 +133,7 @@ class Tuner:
             self.param_space, num_samples=tc.num_samples, seed=tc.seed
         )
         scheduler = tc.scheduler or FIFOScheduler()
-        if tc.metric and hasattr(scheduler, "metric"):
-            scheduler.metric = scheduler.metric or tc.metric
+        scheduler.set_metric_and_mode(tc.metric, tc.mode)
 
         max_conc = tc.max_concurrent_trials or 4
         trials: Dict[str, _Trial] = {}
@@ -193,7 +192,9 @@ class Tuner:
                 except Exception as e:
                     trial.status = "ERROR"
                     trial.error = str(e)
+                    self._stop_actor(trial)
                     searcher.on_trial_complete(trial.trial_id, trial.last_metrics)
+                    scheduler.on_trial_complete(trial.trial_id)
                     continue
                 for row in poll["results"]:
                     metrics = dict(row["metrics"])
@@ -215,10 +216,13 @@ class Tuner:
                     if exploit is not None:
                         source_id, new_config = exploit
                         source = trials.get(source_id)
-                        self._exploit_trial(
+                        applied = self._exploit_trial(
                             trial, source, new_config, train_fn, resources
                         )
-                        continue  # fresh actor; re-poll next tick
+                        if applied and hasattr(scheduler, "commit_exploit"):
+                            scheduler.commit_exploit(trial.trial_id, new_config)
+                        if applied:
+                            continue  # fresh actor; re-poll next tick
                 if poll["error"]:
                     trial.status = "ERROR"
                     trial.error = poll["error"]
@@ -252,11 +256,20 @@ class Tuner:
             # BaseTrainer wrapped as a Tune trainable, §3.4 step 1)
             def run_trainer(config):
                 import copy
+                import dataclasses
 
-                from ..train.session import report as _report
+                from ..train.session import get_context, report as _report
 
                 t = copy.copy(trainable)
                 t.train_loop_config = {**(trainable.train_loop_config or {}), **config}
+                # each trial gets its OWN storage namespace — trials
+                # sharing the trainer's run name would overwrite each
+                # other's checkpoint dirs
+                trial_name = get_context().experiment_name
+                rc = trainable.run_config
+                t.run_config = dataclasses.replace(
+                    rc, name=f"{rc.name or 'trainer'}_{trial_name}"
+                )
                 result = t.fit()
                 if result.error:
                     raise result.error
@@ -305,12 +318,15 @@ class Tuner:
 
     def _exploit_trial(
         self, trial: _Trial, source: Optional[_Trial], new_config, train_fn, resources
-    ) -> None:
+    ) -> bool:
         """PBT exploit: restart `trial` from `source`'s checkpoint with
-        mutated config (reference: pbt.py _exploit)."""
+        mutated config (reference: pbt.py _exploit). Returns whether the
+        exploit was applied (False when the source has no checkpoint
+        yet — the scheduler's population record stays untouched)."""
         if source is None or source.checkpoint_path is None:
-            return
+            return False
         self._stop_actor(trial)
         trial.config = dict(new_config)
         trial.checkpoint_path = source.checkpoint_path
         self._start_trial(trial, train_fn, resources)
+        return True

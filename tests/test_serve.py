@@ -1,0 +1,163 @@
+"""Serve tests (pattern: python/ray/serve/tests/ — deployments against
+a real runtime; routing, composition, batching, autoscaling)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_cleanup(ray_start_4_cpus):
+    yield
+    serve.shutdown()
+
+
+def test_basic_deployment(serve_cleanup):
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+    handle = serve.run(Echo.bind())
+    assert handle.remote("hi").result() == {"echo": "hi"}
+
+
+def test_function_deployment(serve_cleanup):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind())
+    assert handle.remote(21).result() == 42
+
+
+def test_init_args_and_methods(serve_cleanup):
+    @serve.deployment
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def incr(self, by):
+            self.n += by
+            return self.n
+
+    handle = serve.run(Counter.bind(10))
+    assert handle.incr.remote(5).result() == 15
+
+
+def test_multiple_replicas_roundrobin(serve_cleanup):
+    @serve.deployment(num_replicas=2)
+    class WhoAmI:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(WhoAmI.bind())
+    pids = {handle.remote(None).result() for _ in range(16)}
+    assert len(pids) == 2  # both replicas served traffic
+
+
+def test_composition(serve_cleanup):
+    @serve.deployment
+    class Adder:
+        def __init__(self, amount):
+            self.amount = amount
+
+        def __call__(self, x):
+            return x + self.amount
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, a, b):
+            self.a = a  # DeploymentHandles
+            self.b = b
+
+        def __call__(self, x):
+            y = self.a.remote(x).result()
+            return self.b.remote(y).result()
+
+    app = Pipeline.bind(Adder.bind(1), Adder.options(name="Adder2").bind(10))
+    handle = serve.run(app)
+    assert handle.remote(0).result() == 11
+
+
+def test_batching(serve_cleanup):
+    @serve.deployment(max_ongoing_requests=32)
+    class Batched:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def __call__(self, items):
+            # whole batch processed at once
+            n = len(items)
+            return [{"value": x * 2, "batch_size": n} for x in items]
+
+    handle = serve.run(Batched.bind())
+    responses = [handle.remote(i) for i in range(8)]
+    results = [r.result() for r in responses]
+    assert [r["value"] for r in results] == [i * 2 for i in range(8)]
+    assert max(r["batch_size"] for r in results) > 1  # actually batched
+
+
+def test_redeploy_new_version(serve_cleanup):
+    @serve.deployment
+    class V:
+        def __call__(self, _):
+            return 1
+
+    serve.run(V.bind())
+
+    @serve.deployment(name="V")
+    class V2:
+        def __call__(self, _):
+            return 2
+
+    handle = serve.run(V2.bind())
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if handle.remote(None).result() == 2:
+            break
+        time.sleep(0.2)
+    assert handle.remote(None).result() == 2
+
+
+def test_status_and_delete(serve_cleanup):
+    @serve.deployment(num_replicas=1)
+    class S:
+        def __call__(self, _):
+            return "ok"
+
+    serve.run(S.bind())
+    st = serve.status()
+    assert "S" in st["applications"]
+    serve.delete("S")
+    deadline = time.time() + 10
+    while time.time() < deadline and "S" in serve.status()["applications"]:
+        time.sleep(0.1)
+    assert "S" not in serve.status()["applications"]
+
+
+def test_http_ingress(serve_cleanup):
+    @serve.deployment
+    class App:
+        def __call__(self, request):
+            return {"path": request["path"], "method": request["method"]}
+
+    serve.run(App.bind(), route_prefix="/api", http_options={"port": 18765})
+    import json
+    import urllib.request
+
+    deadline = time.time() + 15
+    last = None
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen("http://127.0.0.1:18765/api/x", timeout=5) as r:
+                last = json.loads(r.read())
+            break
+        except Exception as e:
+            last = e
+            time.sleep(0.3)
+    assert isinstance(last, dict), last
+    assert last == {"path": "/api/x", "method": "GET"}
