@@ -47,6 +47,12 @@ class ActorMethod:
         refs = [ObjectRef(r) for r in return_ids]
         return refs[0] if num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: ray.dag — actor.method.bind)."""
+        from .dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *a, **k):
         raise TypeError(f"Actor method '{self._name}' must be called with .remote()")
 

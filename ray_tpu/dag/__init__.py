@@ -1,0 +1,26 @@
+"""ray_tpu.dag — lazy DAG authoring + compiled execution.
+
+Parity: python/ray/dag/ (InputNode/MultiOutputNode/bind;
+CompiledDAG via dag.experimental_compile()).
+"""
+
+from .compiled_dag import CompiledDAG, CompiledDAGRef
+from .dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "ClassMethodNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "DAGNode",
+    "FunctionNode",
+    "InputAttributeNode",
+    "InputNode",
+    "MultiOutputNode",
+]

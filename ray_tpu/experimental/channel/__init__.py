@@ -1,0 +1,21 @@
+"""Channels: fixed-shape zero-copy pipes between processes.
+
+Parity: python/ray/experimental/channel/ — the reference backs compiled
+graphs with mutable plasma objects (shared_memory_channel.py:151) and
+NCCL buffers (torch_tensor_nccl_channel.py). Here:
+
+- ``ShmChannel``: a single-producer single-consumer ring over
+  multiprocessing.shared_memory for fixed-dtype/shape numpy payloads —
+  the host analogue of the reference's mutable plasma channel; writes
+  and reads are memcpy into mapped memory, no serialization, no
+  control-plane round trip.
+- The device analogue of NCCL channels on TPU is NOT a runtime object:
+  stage→stage HBM movement compiles into the program itself
+  (`lax.ppermute` in ray_tpu.parallel.pipeline). A cross-program HBM
+  channel would force a host round-trip, so the framework keeps
+  inter-stage transfer inside jit where ICI DMA is free of the host.
+"""
+
+from .shm_channel import ShmChannel
+
+__all__ = ["ShmChannel"]

@@ -133,6 +133,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: ray.dag — fn.bind)."""
+        from .dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def _remote(self, args, kwargs, opts):
         from ._private import worker
 

@@ -1,0 +1,21 @@
+"""ray_tpu.rllib — reinforcement learning.
+
+Parity: python/ray/rllib/ core shape (AlgorithmConfig builder →
+Algorithm.train(); EnvRunner actor fan-out; jitted Learner update).
+PPO first; the actor/learner pattern generalizes (§2.5).
+"""
+
+from .algorithm import Algorithm
+from .core import MLPSpec, forward, init_mlp_module, sample_actions
+from .env_runner import SingleAgentEnvRunner
+from .ppo import PPOConfig
+
+__all__ = [
+    "Algorithm",
+    "MLPSpec",
+    "PPOConfig",
+    "SingleAgentEnvRunner",
+    "forward",
+    "init_mlp_module",
+    "sample_actions",
+]

@@ -1,0 +1,143 @@
+"""Algorithm: the RL training driver.
+
+Parity: python/ray/rllib/algorithms/algorithm.py (training_step :2038):
+each train() iteration fans rollout collection out to the EnvRunner
+actors, runs the jitted learner update on the concatenated batch, and
+broadcasts fresh weights. Checkpointable (save/restore of params +
+optimizer state), mirroring the reference's Checkpointable mixin.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Algorithm:
+    def __init__(self, config):
+        import jax
+        import optax
+
+        import ray_tpu
+
+        from .core import MLPSpec, init_mlp_module
+        from .env_runner import SingleAgentEnvRunner
+        from .ppo import make_ppo_update
+
+        if config.env is None:
+            raise ValueError("config.environment(env) is required")
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self.config = config
+
+        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self.env_runners = [
+            runner_cls.remote(
+                config.env,
+                config.num_envs_per_env_runner,
+                config.seed + 1000 * i,
+                config.rollout_fragment_length,
+                config.gamma,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        obs_dim = ray_tpu.get(self.env_runners[0].obs_space_dim.remote())
+        num_actions = ray_tpu.get(self.env_runners[0].num_actions.remote())
+        self.spec = MLPSpec(obs_dim, num_actions, tuple(config.hiddens))
+        self.params = init_mlp_module(jax.random.PRNGKey(config.seed), self.spec)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = make_ppo_update(config, self.spec, self.optimizer)
+        self._rng = jax.random.PRNGKey(config.seed + 1)
+        self.iteration = 0
+        self._timesteps = 0
+
+    # ------------------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference: Algorithm.train)."""
+        import jax
+        import ray_tpu
+
+        host_params = jax.tree.map(np.asarray, self.params)
+        rollouts = ray_tpu.get(
+            [
+                r.sample.remote(host_params, self.config.seed + self.iteration * 97 + i)
+                for i, r in enumerate(self.env_runners)
+            ]
+        )
+        # concat across runners on the env axis (time-major T, N)
+        batch = {
+            k: np.concatenate([ro[k] for ro in rollouts], axis=1)
+            for k in ("obs", "actions", "rewards", "dones", "logp", "values")
+        }
+        batch["obs"] = batch["obs"].reshape(
+            batch["obs"].shape[0], batch["obs"].shape[1], -1
+        )
+        batch["final_obs"] = np.concatenate(
+            [ro["final_obs"].reshape(ro["final_obs"].shape[0], -1) for ro in rollouts],
+            axis=0,
+        )
+        self._rng, sub = jax.random.split(self._rng)
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch, sub
+        )
+        self.iteration += 1
+        self._timesteps += int(batch["actions"].size)
+        ep_returns = np.concatenate(
+            [ro["episode_returns"] for ro in rollouts]
+        )
+        result = {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "episode_return_mean": float(ep_returns.mean()) if len(ep_returns) else float("nan"),
+            "num_episodes": int(len(ep_returns)),
+        }
+        result.update({k: float(v) for k, v in metrics.items()})
+        return result
+
+    # ------------------------------------------------------------------
+    def compute_single_action(self, obs) -> int:
+        import jax.numpy as jnp
+
+        from .core import forward
+
+        logits, _ = forward(self.params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(logits[0]))
+
+    def save(self, checkpoint_dir: str) -> str:
+        import jax
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        state = {
+            "params": jax.tree.map(np.asarray, self.params),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "iteration": self.iteration,
+            "timesteps": self._timesteps,
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self.env_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.env_runners = []

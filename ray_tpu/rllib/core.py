@@ -1,0 +1,72 @@
+"""RLModule: the neural-net abstraction (jax-native).
+
+Parity: python/ray/rllib/core/rl_module/ — a module owns inference /
+exploration / train forwards. Here a module is a pure-function pair
+(init, apply) over a params pytree: jit/pjit-ready, no framework
+objects crossing process boundaries (EnvRunner actors receive plain
+arrays).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MLPSpec:
+    """Policy+value network spec (reference analogue: RLModule catalog
+    defaults — fcnet_hiddens)."""
+
+    obs_dim: int
+    num_actions: int
+    hiddens: Tuple[int, ...] = (64, 64)
+    dtype: Any = jnp.float32
+
+
+def init_mlp_module(rng: jax.Array, spec: MLPSpec) -> Dict[str, Any]:
+    """Shared torso + policy and value heads."""
+
+    def dense(key, fan_in, fan_out):
+        scale = 1.0 / math.sqrt(fan_in)
+        return {
+            "w": (jax.random.normal(key, (fan_in, fan_out)) * scale).astype(spec.dtype),
+            "b": jnp.zeros((fan_out,), spec.dtype),
+        }
+
+    keys = jax.random.split(rng, len(spec.hiddens) + 2)
+    layers = []
+    fan_in = spec.obs_dim
+    for i, h in enumerate(spec.hiddens):
+        layers.append(dense(keys[i], fan_in, h))
+        fan_in = h
+    return {
+        "torso": layers,
+        "pi": dense(keys[-2], fan_in, spec.num_actions),
+        "vf": dense(keys[-1], fan_in, 1),
+    }
+
+
+def forward(params: Dict[str, Any], obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
+    x = obs
+    for layer in params["torso"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    logits = x @ params["pi"]["w"] + params["pi"]["b"]
+    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    return logits, value
+
+
+def sample_actions(
+    params: Dict[str, Any], obs: jax.Array, rng: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (actions, logp, value) for exploration rollouts."""
+    logits, value = forward(params, obs)
+    actions = jax.random.categorical(rng, logits)
+    logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), actions]
+    return actions, logp, value

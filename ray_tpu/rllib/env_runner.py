@@ -1,0 +1,126 @@
+"""EnvRunner: rollout-collection actors.
+
+Parity: python/ray/rllib/env/single_agent_env_runner.py +
+env_runner_group.py:71 — actors own gymnasium vector envs, receive
+policy weights each iteration, and return fixed-length rollout batches
+(the async actor fan-out pattern §2.5). Rollouts are plain numpy so the
+learner can device_put them straight into HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+
+class SingleAgentEnvRunner:
+    def __init__(
+        self,
+        env_creator: Union[str, Callable],
+        num_envs: int = 1,
+        seed: Optional[int] = None,
+        rollout_fragment_length: int = 128,
+        gamma: float = 0.99,
+    ):
+        import gymnasium as gym
+
+        if isinstance(env_creator, str):
+            env_id = env_creator
+            fns = [lambda: gym.make(env_id) for _ in range(num_envs)]
+        else:
+            fns = [env_creator for _ in range(num_envs)]
+        # SAME_STEP autoreset: a done step immediately returns the reset
+        # obs, with the true final obs in infos — so every recorded
+        # transition is real. (gymnasium >=1.0 defaults to NEXT_STEP,
+        # which inserts a filler transition per episode end that would
+        # corrupt PPO's batch.)
+        self.envs = gym.vector.SyncVectorEnv(
+            fns, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP
+        )
+        self.num_envs = num_envs
+        self.fragment = rollout_fragment_length
+        self.gamma = gamma
+        self.rng = np.random.default_rng(seed)
+        self.obs, _ = self.envs.reset(seed=seed)
+        # episode-return bookkeeping
+        self._ep_returns = np.zeros(num_envs)
+        self._ep_lens = np.zeros(num_envs, dtype=np.int64)
+        self.completed_returns: List[float] = []
+
+    def obs_space_dim(self) -> int:
+        return int(np.prod(self.envs.single_observation_space.shape))
+
+    def num_actions(self) -> int:
+        return int(self.envs.single_action_space.n)
+
+    def sample(self, params: Dict[str, Any], rng_seed: int) -> Dict[str, np.ndarray]:
+        """Collect one fragment with the given policy weights. Returns
+        time-major batch {obs, actions, rewards, dones, logp, values,
+        final_obs} + episode stats."""
+        import jax
+
+        from .core import sample_actions
+
+        key = jax.random.PRNGKey(rng_seed)
+        T, N = self.fragment, self.num_envs
+        obs_buf = np.zeros((T, N) + self.envs.single_observation_space.shape, np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+
+        obs = self.obs
+        for t in range(T):
+            key, sub = jax.random.split(key)
+            actions, logp, value = sample_actions(
+                params, obs.astype(np.float32), sub
+            )
+            actions = np.asarray(actions)
+            next_obs, rewards, term, trunc, infos = self.envs.step(actions)
+            done = np.logical_or(term, trunc)
+            rewards = np.asarray(rewards, np.float32).copy()
+            # time-limit truncation is NOT termination: bootstrap the
+            # cut-off return from V(final_obs) (standard PPO truncation
+            # handling; the GAE then treats the step as terminal)
+            if np.any(trunc):
+                final_obs = infos.get("final_obs")
+                for i in np.nonzero(trunc)[0]:
+                    fo = (
+                        final_obs[i]
+                        if final_obs is not None and final_obs[i] is not None
+                        else next_obs[i]
+                    )
+                    from .core import forward as _fwd
+
+                    _, v_fin = _fwd(
+                        params, np.asarray(fo, np.float32).reshape(1, -1)
+                    )
+                    rewards[i] += self.gamma * float(v_fin[0])
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            rew_buf[t] = rewards
+            done_buf[t] = done
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            # track episode returns (vector env auto-resets)
+            self._ep_returns += rewards
+            self._ep_lens += 1
+            for i in np.nonzero(done)[0]:
+                self.completed_returns.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+                self._ep_lens[i] = 0
+            obs = next_obs
+        self.obs = obs
+        stats_returns = self.completed_returns[-100:]
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "final_obs": obs.astype(np.float32),
+            "episode_returns": np.asarray(stats_returns, np.float32),
+        }

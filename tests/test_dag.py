@@ -1,0 +1,111 @@
+"""DAG + channel tests (pattern: python/ray/dag/tests/ +
+experimental/channel tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.experimental.channel import ShmChannel
+
+
+def test_function_dag(ray_start_4_cpus):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    with InputNode() as inp:
+        dag = square.bind(add.bind(inp, 3))
+    ref = dag.execute(2)
+    assert ray_tpu.get(ref) == 25
+
+
+def test_actor_dag_state(ray_start_4_cpus):
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    acc = Acc.remote()
+    with InputNode() as inp:
+        dag = acc.add.bind(inp)
+    assert ray_tpu.get(dag.execute(5)) == 5
+    assert ray_tpu.get(dag.execute(7)) == 12  # state persists
+
+
+def test_multi_output(ray_start_4_cpus):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def dec(x):
+        return x - 1
+
+    with InputNode() as inp:
+        dag = MultiOutputNode([inc.bind(inp), dec.bind(inp)])
+    refs = dag.execute(10)
+    assert ray_tpu.get(refs) == [11, 9]
+
+
+def test_input_attribute(ray_start_4_cpus):
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        dag = mul.bind(inp["x"], inp["y"])
+    assert ray_tpu.get(dag.execute({"x": 3, "y": 4})) == 12
+
+
+def test_compiled_dag_pipelining(ray_start_4_cpus):
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, mult):
+            self.mult = mult
+
+        def run(self, x):
+            return x * self.mult
+
+    s1, s2 = Stage.remote(2), Stage.remote(10)
+    with InputNode() as inp:
+        dag = s2.run.bind(s1.run.bind(inp))
+    compiled = dag.experimental_compile(max_inflight_executions=4)
+    refs = [compiled.execute(i) for i in range(8)]  # overlapped
+    assert [r.get() for r in refs] == [i * 20 for i in range(8)]
+    compiled.teardown()
+
+
+def test_shm_channel_roundtrip(ray_start_4_cpus):
+    ch = ShmChannel.create(shape=(4,), dtype="float32", capacity=2)
+    try:
+        @ray_tpu.remote
+        def producer(ch, n):
+            for i in range(n):
+                ch.write(np.full((4,), float(i), dtype=np.float32))
+            return True
+
+        ref = producer.remote(ch, 5)
+        got = [ch.read() for _ in range(5)]
+        assert ray_tpu.get(ref) is True
+        for i, arr in enumerate(got):
+            np.testing.assert_allclose(arr, np.full((4,), float(i)))
+    finally:
+        ch.close(unlink=True)
+
+
+def test_shm_channel_shape_check():
+    ch = ShmChannel.create(shape=(2, 2), dtype="float32")
+    try:
+        with pytest.raises(ValueError, match="shape"):
+            ch.write(np.zeros((3,), dtype=np.float32))
+    finally:
+        ch.close(unlink=True)
