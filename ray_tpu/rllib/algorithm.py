@@ -19,7 +19,6 @@ import numpy as np
 class Algorithm:
     def __init__(self, config):
         import jax
-        import optax
 
         import ray_tpu
 
@@ -48,12 +47,8 @@ class Algorithm:
         num_actions = ray_tpu.get(self.env_runners[0].num_actions.remote())
         self.spec = MLPSpec(obs_dim, num_actions, tuple(config.hiddens))
         self.params = init_mlp_module(jax.random.PRNGKey(config.seed), self.spec)
-        self.optimizer = optax.chain(
-            optax.clip_by_global_norm(config.grad_clip),
-            optax.adam(config.lr),
-        )
+        self.optimizer, self._update = make_ppo_update(config, self.spec)
         self.opt_state = self.optimizer.init(self.params)
-        self._update = make_ppo_update(config, self.spec, self.optimizer)
         self._rng = jax.random.PRNGKey(config.seed + 1)
         self.iteration = 0
         self._timesteps = 0

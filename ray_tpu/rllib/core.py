@@ -30,43 +30,69 @@ class MLPSpec:
 
 
 def init_mlp_module(rng: jax.Array, spec: MLPSpec) -> Dict[str, Any]:
-    """Shared torso + policy and value heads."""
+    """Separate policy and value torsos with orthogonal init.
 
-    def dense(key, fan_in, fan_out):
-        scale = 1.0 / math.sqrt(fan_in)
+    Mirrors the reference's RLlib catalog defaults (vf_share_layers=False)
+    and the standard PPO init recipe: orthogonal(sqrt(2)) hidden layers,
+    orthogonal(0.01) policy head, orthogonal(1.0) value head — the small
+    policy-head gain keeps the initial policy near-uniform so early value
+    errors can't collapse exploration.
+    """
+
+    def dense(key, fan_in, fan_out, gain):
+        w = jax.nn.initializers.orthogonal(gain)(key, (fan_in, fan_out))
         return {
-            "w": (jax.random.normal(key, (fan_in, fan_out)) * scale).astype(spec.dtype),
+            "w": w.astype(spec.dtype),
             "b": jnp.zeros((fan_out,), spec.dtype),
         }
 
-    keys = jax.random.split(rng, len(spec.hiddens) + 2)
-    layers = []
-    fan_in = spec.obs_dim
-    for i, h in enumerate(spec.hiddens):
-        layers.append(dense(keys[i], fan_in, h))
-        fan_in = h
+    def mlp(key, head_out, head_gain):
+        keys = jax.random.split(key, len(spec.hiddens) + 1)
+        layers = []
+        fan_in = spec.obs_dim
+        for i, h in enumerate(spec.hiddens):
+            layers.append(dense(keys[i], fan_in, h, math.sqrt(2.0)))
+            fan_in = h
+        head = dense(keys[-1], fan_in, head_out, head_gain)
+        return layers, head
+
+    k_pi, k_vf = jax.random.split(rng)
+    pi_torso, pi_head = mlp(k_pi, spec.num_actions, 0.01)
+    vf_torso, vf_head = mlp(k_vf, 1, 1.0)
     return {
-        "torso": layers,
-        "pi": dense(keys[-2], fan_in, spec.num_actions),
-        "vf": dense(keys[-1], fan_in, 1),
+        "pi_torso": pi_torso,
+        "pi": pi_head,
+        "vf_torso": vf_torso,
+        "vf": vf_head,
     }
+
+
+def _mlp_forward(layers, head, x):
+    for layer in layers:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ head["w"] + head["b"]
 
 
 def forward(params: Dict[str, Any], obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """obs (B, obs_dim) -> (logits (B, A), value (B,))."""
-    x = obs
-    for layer in params["torso"]:
-        x = jnp.tanh(x @ layer["w"] + layer["b"])
-    logits = x @ params["pi"]["w"] + params["pi"]["b"]
-    value = (x @ params["vf"]["w"] + params["vf"]["b"])[..., 0]
+    logits = _mlp_forward(params["pi_torso"], params["pi"], obs)
+    value = _mlp_forward(params["vf_torso"], params["vf"], obs)[..., 0]
     return logits, value
 
 
+@jax.jit
 def sample_actions(
     params: Dict[str, Any], obs: jax.Array, rng: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """-> (actions, logp, value) for exploration rollouts."""
+    """-> (actions, logp, value) for exploration rollouts. Jitted: the
+    rollout hot loop calls this once per vector-env step."""
     logits, value = forward(params, obs)
     actions = jax.random.categorical(rng, logits)
     logp = jax.nn.log_softmax(logits)[jnp.arange(obs.shape[0]), actions]
     return actions, logp, value
+
+
+@jax.jit
+def values_only(params: Dict[str, Any], obs: jax.Array) -> jax.Array:
+    """Batched V(s) for truncation bootstraps (jitted)."""
+    return forward(params, obs)[1]

@@ -81,23 +81,31 @@ class SingleAgentEnvRunner:
             next_obs, rewards, term, trunc, infos = self.envs.step(actions)
             done = np.logical_or(term, trunc)
             rewards = np.asarray(rewards, np.float32).copy()
+            # episode stats must see the RAW env rewards — the truncation
+            # bootstrap below is a learning-signal adjustment only and
+            # must not inflate episode_return_mean
+            raw_rewards = rewards.copy()
             # time-limit truncation is NOT termination: bootstrap the
             # cut-off return from V(final_obs) (standard PPO truncation
             # handling; the GAE then treats the step as terminal)
             if np.any(trunc):
-                final_obs = infos.get("final_obs")
-                for i in np.nonzero(trunc)[0]:
-                    fo = (
-                        final_obs[i]
-                        if final_obs is not None and final_obs[i] is not None
-                        else next_obs[i]
-                    )
-                    from .core import forward as _fwd
+                from .core import values_only
 
-                    _, v_fin = _fwd(
-                        params, np.asarray(fo, np.float32).reshape(1, -1)
-                    )
-                    rewards[i] += self.gamma * float(v_fin[0])
+                final_obs = infos.get("final_obs")
+                idx = np.nonzero(trunc)[0]
+                fo = np.stack(
+                    [
+                        np.asarray(
+                            final_obs[i]
+                            if final_obs is not None and final_obs[i] is not None
+                            else next_obs[i],
+                            np.float32,
+                        ).reshape(-1)
+                        for i in idx
+                    ]
+                )
+                v_fin = np.asarray(values_only(params, fo))
+                rewards[idx] += self.gamma * v_fin
             obs_buf[t] = obs
             act_buf[t] = actions
             rew_buf[t] = rewards
@@ -105,7 +113,7 @@ class SingleAgentEnvRunner:
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
             # track episode returns (vector env auto-resets)
-            self._ep_returns += rewards
+            self._ep_returns += raw_rewards
             self._ep_lens += 1
             for i in np.nonzero(done)[0]:
                 self.completed_returns.append(float(self._ep_returns[i]))

@@ -98,10 +98,33 @@ def compute_gae(rewards, values, dones, final_value, gamma, lam):
     return advs, advs + values
 
 
-def make_ppo_update(config: PPOConfig, spec: MLPSpec, optimizer):
-    """Build the jitted full update: GAE + epochs × minibatches of
-    clipped-surrogate SGD. Everything static-shaped for XLA."""
+_UPDATE_CACHE: dict = {}
+
+
+def make_ppo_update(config: PPOConfig, spec: MLPSpec):
+    """Build (optimizer, jitted update): GAE + epochs × minibatches of
+    clipped-surrogate SGD. Everything static-shaped for XLA.
+
+    Builds the optimizer itself (from config.lr/grad_clip) so the cache
+    key fully determines the returned closure. Cached per (hyperparams,
+    spec) so repeated Algorithm builds in one process (e.g. a test
+    suite, or Tune trials) reuse the compiled executable instead of
+    retracing."""
     import optax
+
+    cache_key = (
+        config.lr, config.gamma, config.lambda_, config.clip_param,
+        config.vf_loss_coeff, config.entropy_coeff, config.num_epochs,
+        config.minibatch_size, config.grad_clip, spec,
+    )
+    cached = _UPDATE_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(config.grad_clip),
+        optax.adam(config.lr),
+    )
 
     def loss_fn(params, batch):
         logits, values = forward(params, batch["obs"])
@@ -182,4 +205,5 @@ def make_ppo_update(config: PPOConfig, spec: MLPSpec, optimizer):
         metrics = jax.tree.map(lambda m: m.mean(), metrics)
         return params, opt_state, metrics
 
-    return update
+    _UPDATE_CACHE[cache_key] = (optimizer, update)
+    return optimizer, update

@@ -24,21 +24,69 @@ def _rid(replica) -> bytes:
 
 
 class DeploymentResponse:
-    """Future for one request (parity: serve.handle.DeploymentResponse)."""
+    """Future for one request (parity: serve.handle.DeploymentResponse).
 
-    def __init__(self, ref):
+    Holds the routing context so a request that landed on a replica torn
+    down mid-flight (redeploy, scale-down, crash) is transparently
+    re-routed — the reference's router likewise reschedules on replica
+    death rather than surfacing ActorDiedError to the caller.
+    """
+
+    _MAX_RETRIES = 3
+
+    def __init__(self, ref, handle=None, method=None, args=(), kwargs=None):
         self._ref = ref
+        self._handle = handle
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs or {}
+
+    def _reroute(self) -> None:
+        """Re-send this request to a live replica and adopt the new ref
+        (so composition and repeat result() calls follow the retry).
+
+        NOTE: this makes delivery at-least-once — a replica that died
+        mid-execution may have run side effects before the retry. Same
+        tradeoff as a load-balancing proxy; stateful non-idempotent
+        deployments should disable retries by catching ActorDiedError
+        upstream or keying requests idempotently.
+        """
+        self._handle._refresh(force=True)
+        fresh = self._handle._route(self._method, self._args, self._kwargs)
+        self._ref = fresh._ref
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
 
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        for attempt in range(self._MAX_RETRIES + 1):
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout_s)
+            except ActorDiedError:
+                if self._handle is None or attempt == self._MAX_RETRIES:
+                    raise
+                self._reroute()
 
     def _to_object_ref(self):
         return self._ref
 
     def __await__(self):
-        return self._ref.__await__()
+        import asyncio
+
+        from ray_tpu.exceptions import ActorDiedError
+
+        async def _get():
+            for attempt in range(self._MAX_RETRIES + 1):
+                try:
+                    return await self._ref
+                except ActorDiedError:
+                    if self._handle is None or attempt == self._MAX_RETRIES:
+                        raise
+                    # _reroute blocks (controller RPC + replica wait):
+                    # keep it off the event loop
+                    await asyncio.to_thread(self._reroute)
+
+        return _get().__await__()
 
 
 class DeploymentHandle:
@@ -133,7 +181,7 @@ class DeploymentHandle:
         ref = replica.handle_request.remote(method, args, kwargs)
         with self._lock:
             self._inflight[ref] = rid
-        return DeploymentResponse(ref)
+        return DeploymentResponse(ref, self, method, args, kwargs)
 
     def _pick(self, replicas: List[Any]):
         """Power-of-two-choices on caller-side outstanding counts."""

@@ -11,7 +11,14 @@ python/ray/experimental/channel/cpu_communicator.py).
 import os
 
 # Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-set (not setdefault): the kernel env ships JAX_PLATFORMS=axon +
+# PALLAS_AXON_POOL_IPS, which a sitecustomize hook turns into a TPU PJRT
+# registration in EVERY python process — including spawned worker
+# processes, whose rollout/train steps would then run over the TPU
+# tunnel one RPC per step. Tests pin the whole process tree to the
+# virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
@@ -25,7 +32,41 @@ import jax
 # the config back to cpu so tests run on the virtual 8-device mesh.
 jax.config.update("jax_platforms", "cpu")
 
+import signal
+
 import pytest
+
+# Per-test wall-clock cap (reference parity: pytest.ini timeout=180).
+# SIGALRM-based so no extra dependency; pytest runs tests in the main
+# thread, where the alarm is deliverable.
+TEST_TIMEOUT_S = int(os.environ.get("RAY_TPU_TEST_TIMEOUT", "180"))
+
+
+def _alarm_wrapped(phase):
+    @pytest.hookimpl(hookwrapper=True)
+    def hook(item):
+        def _handler(signum, frame):
+            raise TimeoutError(
+                f"test {phase} exceeded {TEST_TIMEOUT_S}s timeout "
+                f"(RAY_TPU_TEST_TIMEOUT)"
+            )
+
+        old = signal.signal(signal.SIGALRM, _handler)
+        signal.alarm(TEST_TIMEOUT_S)
+        try:
+            yield
+        finally:
+            signal.alarm(0)
+            signal.signal(signal.SIGALRM, old)
+
+    return hook
+
+
+# Cover setup and teardown too — a hang in ray_tpu.init inside a fixture
+# must be killed just like a hang in the test body (pytest-timeout parity).
+pytest_runtest_setup = _alarm_wrapped("setup")
+pytest_runtest_call = _alarm_wrapped("call")
+pytest_runtest_teardown = _alarm_wrapped("teardown")
 
 
 @pytest.fixture
