@@ -51,7 +51,8 @@ def main() -> None:
         batch, seq, steps = 4, 128, 3
     else:
         cfg = dataclasses.replace(
-            llama.LLAMA_BENCH, param_dtype=jnp.bfloat16, remat=True
+            llama.LLAMA_BENCH, param_dtype=jnp.bfloat16, remat=True,
+            attention_impl="flash",  # Pallas kernel on TPU (ops/pallas_attention)
         )
         batch, seq, steps = 8, 2048, 10
 
