@@ -30,13 +30,22 @@ from .object_store import INLINE_THRESHOLD, ShmObjectStore
 from .serialization import dumps_inline, loads_inline
 
 
+def connect_hub(addr: str):
+    """Dial the hub: "tcp://host:port" (cluster mode) or an AF_UNIX path."""
+    if addr.startswith("tcp://"):
+        host, port = addr[6:].rsplit(":", 1)
+        return MpClient((host, int(port)), family="AF_INET")
+    return MpClient(addr, family="AF_UNIX")
+
+
 class CoreClient:
     def __init__(self, hub_addr: str, session_dir: str, role: str, worker_id: str):
         self.role = role
         self.worker_id = worker_id
         self.session_dir = session_dir
+        self.node_id = os.environ.get("RAY_TPU_NODE_ID", "node0")
         self.store = ShmObjectStore(session_dir)
-        self.conn = MpClient(hub_addr, family="AF_UNIX")
+        self.conn = connect_hub(hub_addr)
         self._send_lock = threading.Lock()
         self._send_buf: List[tuple] = []
         self._buf_evt = threading.Event()
@@ -48,7 +57,8 @@ class CoreClient:
         self._seen_fns: Dict[str, Any] = {}
         self.task_queue: "queue.Queue" = queue.Queue()
         self._closed = False
-        self.send(P.HELLO, {"role": role, "worker_id": worker_id, "pid": os.getpid()})
+        self.send(P.HELLO, {"role": role, "worker_id": worker_id,
+                            "pid": os.getpid(), "node_id": self.node_id})
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="core-client-reader")
         self._reader.start()
 
@@ -165,7 +175,19 @@ class CoreClient:
 
             return loads_oob(header, bufs)
         if kind == P.VAL_SHM:
-            return self.store.get(payload)
+            try:
+                return self.store.get(payload)
+            except FileNotFoundError:
+                # segment lives on another node: pull it through the hub
+                # (reference: object manager pull, ownership directory)
+                reply = self.request(P.FETCH_OBJECT, {"object_id": oid_bytes})
+                if reply.get("data") is None:
+                    raise exceptions.ObjectLostError(
+                        f"object {oid_bytes.hex()} unavailable: "
+                        f"{reply.get('error')}"
+                    ) from None
+                self.store.write_segment(payload, reply["data"])
+                return self.store.get(payload)
         if kind == P.VAL_ERROR:
             err = loads_inline(payload)
             raise err
@@ -229,6 +251,9 @@ class CoreClient:
         with self._obj_cache_lock:
             for o in object_ids:
                 self._obj_cache.pop(o.binary(), None)
+        for o in object_ids:
+            # drop any locally-fetched copy of a remote segment too
+            self.store.free(o.hex())
         self.send_async(P.FREE, {"object_ids": [o.binary() for o in object_ids]})
 
     # ----------------------------------------------------------------- tasks

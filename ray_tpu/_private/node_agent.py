@@ -1,0 +1,156 @@
+"""Node agent: the per-host daemon of a multi-host cluster.
+
+The analogue of the reference's raylet/NodeManager
+(src/ray/raylet/node_manager.h:122, main.cc) reduced to what a TPU pod
+actually needs from a per-host runtime: register the host's resources
+with the hub, fork worker processes on demand, serve shm-segment reads
+for cross-node object fetches, and report child deaths. Scheduling
+stays centralized in the hub (single-controller, like the GCS-direct
+actor-scheduling mode, gcs_actor_scheduler.cc:54) — the agent is a
+thin execution arm, so there is no raylet-side state to keep consistent.
+
+Wire: one TCP connection to the hub (protocol.py REGISTER_NODE /
+SPAWN_WORKER / WORKER_EXITED / OBJ_READ / OBJ_UNLINK / KILL).
+
+Spawned workers connect straight to the hub themselves; the agent only
+owns their lifetime (terminate on KILL/SIGTERM, reap on exit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+from typing import Dict
+
+from . import protocol as P
+from .serialization import dumps_inline, loads_inline
+
+
+class NodeAgent:
+    def __init__(self):
+        from .client import connect_hub
+
+        self.hub_addr = os.environ["RAY_TPU_HUB_ADDR"]
+        self.node_id = os.environ["RAY_TPU_NODE_ID"]
+        self.session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+        self.hostname = os.environ.get("RAY_TPU_NODE_HOSTNAME") or socket.gethostname()
+        self.ip = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+        os.makedirs(os.path.join(self.session_dir, "objects"), exist_ok=True)
+        self.children: Dict[str, subprocess.Popen] = {}
+        self.conn = connect_hub(self.hub_addr)
+
+        resources = {"CPU": float(os.environ.get("RAY_TPU_NUM_CPUS", "1"))}
+        ntpu = int(os.environ.get("RAY_TPU_NUM_TPUS", "0"))
+        if ntpu:
+            resources["TPU"] = float(ntpu)
+        resources["memory"] = float(
+            os.environ.get("RAY_TPU_MEMORY", 64 * 1024**3)
+        )
+        custom = os.environ.get("RAY_TPU_CUSTOM_RESOURCES")
+        if custom:
+            resources.update({k: float(v) for k, v in json.loads(custom).items()})
+        self._send(
+            P.REGISTER_NODE,
+            {
+                "req_id": 0,
+                "node_id": self.node_id,
+                "hostname": self.hostname,
+                "ip": self.ip,
+                "session_dir": self.session_dir,
+                "resources": resources,
+                "tpu_chip_ids": list(range(ntpu)),
+                "max_workers": int(
+                    os.environ.get("RAY_TPU_MAX_WORKERS")
+                    or max(4, int(resources["CPU"]))
+                ),
+            },
+        )
+
+    def _send(self, msg_type: str, payload: dict) -> None:
+        self.conn.send_bytes(dumps_inline((msg_type, payload)))
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        signal.signal(signal.SIGTERM, lambda *a: self._shutdown())
+        try:
+            while True:
+                if self.conn.poll(1.0):
+                    blob = self.conn.recv_bytes()
+                    msg_type, payload = loads_inline(blob)
+                    self._handle(msg_type, payload)
+                self._reap()
+        except (EOFError, OSError):
+            pass  # hub gone: tear down
+        finally:
+            self._shutdown()
+
+    def _handle(self, msg_type: str, p: dict) -> None:
+        if msg_type == P.SPAWN_WORKER:
+            env = dict(os.environ)
+            env.update(p["env"])
+            env["RAY_TPU_SESSION_DIR"] = self.session_dir
+            env["RAY_TPU_NODE_ID"] = self.node_id
+            env["RAY_TPU_NODE_HOSTNAME"] = self.hostname
+            env["RAY_TPU_NODE_IP"] = self.ip
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_process"],
+                env=env,
+            )
+            self.children[p["env"]["RAY_TPU_WORKER_ID"]] = proc
+        elif msg_type == P.OBJ_READ:
+            path = os.path.join(self.session_dir, "objects", p["name"])
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+                self._send(P.OBJ_READ_REPLY,
+                           {"fetch_id": p["fetch_id"], "data": data})
+            except OSError as err:
+                self._send(
+                    P.OBJ_READ_REPLY,
+                    {"fetch_id": p["fetch_id"], "data": None, "error": str(err)},
+                )
+        elif msg_type == P.OBJ_UNLINK:
+            try:
+                os.unlink(os.path.join(self.session_dir, "objects", p["name"]))
+            except OSError:
+                pass
+        elif msg_type == P.KILL:
+            raise EOFError  # unified teardown path
+
+    def _reap(self) -> None:
+        for wid, proc in list(self.children.items()):
+            code = proc.poll()
+            if code is not None:
+                del self.children[wid]
+                try:
+                    self._send(P.WORKER_EXITED, {"worker_id": wid, "code": code})
+                except (OSError, BrokenPipeError):
+                    pass
+
+    def _shutdown(self) -> None:
+        for proc in self.children.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in self.children.values():
+            try:
+                proc.wait(timeout=2)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        os._exit(0)
+
+
+def main() -> None:
+    NodeAgent().run()
+
+
+if __name__ == "__main__":
+    main()

@@ -143,6 +143,17 @@ class ShmObjectStore:
             off = _align(off + blen)
         return serialization.loads_oob(header, buffers)
 
+    def write_segment(self, name: str, data: bytes) -> None:
+        """Install a segment fetched from another node (byte-identical
+        copy of the producer's file; get() then maps it locally). The
+        tmp name is per-process: concurrent fetchers of the same object
+        must not race each other's os.replace."""
+        path = self._path(name)
+        tmp = f"{path}.fetch.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
     def contains(self, name: str) -> bool:
         return name in self._segments or os.path.exists(self._path(name))
 

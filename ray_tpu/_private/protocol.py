@@ -40,6 +40,16 @@ SHUTDOWN = "shutdown"
 TASK_DONE = "task_done"
 ACTOR_READY = "actor_ready"
 
+# node agent <-> hub (multi-host: one agent per host, reference analogue
+# src/ray/raylet/node_manager.h:122 registering with the GCS)
+REGISTER_NODE = "register_node"
+SPAWN_WORKER = "spawn_worker"      # hub -> agent: fork a worker process
+WORKER_EXITED = "worker_exited"    # agent -> hub: child died pre-connect
+OBJ_READ = "obj_read"              # hub -> agent: read a shm segment
+OBJ_READ_REPLY = "obj_read_reply"  # agent -> hub: segment bytes
+OBJ_UNLINK = "obj_unlink"          # hub -> agent: free a shm segment
+FETCH_OBJECT = "fetch_object"      # client -> hub: pull a remote segment
+
 # hub -> worker
 EXEC_TASK = "exec_task"
 EXEC_ACTOR_CREATE = "exec_actor_create"

@@ -109,6 +109,10 @@ def init(
             max_workers=max_workers,
             tpu_chip_ids=list(range(int(ntpu))) if ntpu else [],
             worker_env=worker_env,
+            # cluster mode: listen on TCP so node agents on other hosts
+            # (or simulated hosts in tests) can register
+            tcp=bool(kwargs.get("_tcp_hub") or os.environ.get("RAY_TPU_TCP_HUB")),
+            host=kwargs.get("_hub_host", "127.0.0.1"),
         )
         _hub.start()
         _client = CoreClient(_hub.addr, _session_dir, role="driver", worker_id="driver")

@@ -95,9 +95,13 @@ def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
     if strategy is not None:
         from .util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
+        from .util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
         if isinstance(strategy, PlacementGroupSchedulingStrategy):
             pg = strategy.placement_group
             out["placement_group"] = (pg.id.binary(), strategy.placement_group_bundle_index)
+        elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+            out["node_affinity"] = (strategy.node_id, strategy.soft)
         elif isinstance(strategy, str):
             out["strategy"] = strategy
     if opts.get("max_retries") is not None:

@@ -38,9 +38,24 @@ class TrainWorker:
 
         return {
             "pid": os.getpid(),
-            "hostname": socket.gethostname(),
+            # node agents export the (possibly simulated) host identity;
+            # node_ip is what rank-0 peers can actually dial for the
+            # jax.distributed coordinator
+            "hostname": os.environ.get("RAY_TPU_NODE_HOSTNAME")
+            or socket.gethostname(),
+            "node_ip": os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1"),
             "tpu_chips": os.environ.get("TPU_VISIBLE_CHIPS", ""),
         }
+
+    def pick_free_port(self) -> int:
+        """Bind-probe a free port (runs on rank 0's host; the coordinator
+        binds it immediately after, same pattern as the reference's
+        get_address_and_port, train/torch/config.py:66)."""
+        import socket
+
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
 
     def setup_session(
         self,
@@ -50,6 +65,9 @@ class TrainWorker:
         dataset_shards: Optional[Dict[str, Any]] = None,
         start_iteration: int = 0,
         sync_reports: bool = False,
+        local_rank: Optional[int] = None,
+        local_world_size: Optional[int] = None,
+        node_rank: int = 0,
     ) -> None:
         from .._checkpoint import Checkpoint
         from ..session import TrainContext, _TrainSession, _init_session
@@ -58,9 +76,11 @@ class TrainWorker:
         ctx = TrainContext(
             world_size=self.world_size,
             world_rank=self.rank,
-            local_rank=self.rank,  # single-host: local == world
-            local_world_size=self.world_size,
-            node_rank=0,
+            local_rank=self.rank if local_rank is None else local_rank,
+            local_world_size=(
+                self.world_size if local_world_size is None else local_world_size
+            ),
+            node_rank=node_rank,
             experiment_name=self.experiment_name,
         )
         ckpt = (

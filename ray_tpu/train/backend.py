@@ -52,7 +52,7 @@ class JaxConfig(BackendConfig):
     single-controller model needs no rendezvous).
     """
 
-    coordinator_port: int = 8476
+    coordinator_port: int = 0  # 0 = pick a free port on rank 0's host
     mesh_shape: Optional[Dict[str, int]] = None
     enable_distributed: Optional[bool] = None  # None = auto (world_size > 1 hosts)
 
@@ -90,7 +90,14 @@ class _JaxBackend(Backend):
         import ray_tpu
 
         rank0 = worker_group.workers[0]
-        addr = f"{rank0.metadata['hostname']}:{backend_config.coordinator_port}"
+        port = backend_config.coordinator_port
+        if not port:
+            port = ray_tpu.get(rank0.actor.pick_free_port.remote())
+        # node_ip, not hostname: simulated hosts have fake hostnames, and
+        # real pods may not resolve each other's names — the IP the agent
+        # registered with is what peers can dial.
+        ip = rank0.metadata.get("node_ip") or rank0.metadata["hostname"]
+        addr = f"{ip}:{port}"
         refs = [
             w.actor.run_backend_hook.remote(
                 _jax_worker_setup, addr, n, w.rank

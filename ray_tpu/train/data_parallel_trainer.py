@@ -133,8 +133,20 @@ class DataParallelTrainer:
             backend.on_start(wg, self.backend_config)
             # per-worker dataset shards (streaming split)
             shards_per_worker = self._split_datasets(len(wg))
+            # node-aware ranks: workers are sorted by hostname, so local
+            # ranks are positions within each host's contiguous span
+            hosts: list = []
+            local_ranks = []
+            local_sizes: dict = {}
+            for w in wg.workers:
+                h = w.metadata["hostname"]
+                if not hosts or hosts[-1] != h:
+                    hosts.append(h)
+                local_ranks.append(local_sizes.get(h, 0))
+                local_sizes[h] = local_ranks[-1] + 1
             refs = []
             for i, w in enumerate(wg.workers):
+                h = w.metadata["hostname"]
                 refs.append(
                     w.actor.setup_session.remote(
                         w.rank,
@@ -142,6 +154,9 @@ class DataParallelTrainer:
                         latest_ckpt.path if latest_ckpt else None,
                         shards_per_worker[i],
                         self._next_iteration,
+                        local_rank=local_ranks[i],
+                        local_world_size=local_sizes[h],
+                        node_rank=hosts.index(h),
                     )
                 )
             ray_tpu.get(refs)

@@ -96,11 +96,17 @@ def test_pg_task_queues_until_ready(ray_start_regular):
 
     @ray_tpu.remote
     def blocker():
-        time.sleep(3)
+        time.sleep(6)
 
-    # occupy both CPUs so the PG cannot reserve
+    # occupy both CPUs so the PG cannot reserve; poll until both blocker
+    # tasks actually hold their CPUs (worker spawn can be slow under load)
     b1, b2 = blocker.remote(), blocker.remote()
-    time.sleep(0.5)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == 0:
+            break
+        time.sleep(0.05)
+    assert ray_tpu.available_resources().get("CPU", 0) == 0
     pg = placement_group([{"CPU": 2}])
 
     @ray_tpu.remote(num_cpus=1)
