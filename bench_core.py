@@ -1,0 +1,273 @@
+"""Core-runtime microbenchmarks.
+
+Mirrors the reference's harness (release/microbenchmark/
+run_microbenchmark.py -> python/ray/_private/ray_perf.py): same metric
+names and shapes as BASELINE.md's table so the ratios are 1:1
+comparable. Prints one JSON line per metric:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+and a trailing summary line. Baselines were measured on an m4.16xlarge
+(64 vCPU); this harness reports whatever hardware it runs on (the CI
+box has 1-2 cores), so treat vs_baseline as directional for the
+control-plane rows and exact for the in-memory ones.
+
+Run: python bench_core.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+BASELINES = {
+    "single_client_tasks_sync": 963.0,
+    "single_client_tasks_async": 7293.0,
+    "multi_client_tasks_async": 22747.0,
+    "1_1_actor_calls_sync": 2043.0,
+    "1_1_actor_calls_async": 8120.0,
+    "1_1_actor_calls_concurrent": 5396.0,
+    "1_n_actor_calls_async": 8164.0,
+    "n_n_actor_calls_async": 27273.0,
+    "n_n_actor_calls_with_arg_async": 2541.0,
+    "1_1_async_actor_calls_sync": 1423.0,
+    "1_1_async_actor_calls_async": 4826.0,
+    "single_client_get_calls": 10428.0,
+    "single_client_put_calls": 4968.0,
+    "single_client_put_gigabytes": 19.4,
+    "single_client_wait_1k_refs": 4.77,
+    "placement_group_create_removal": 752.0,
+}
+
+QUICK = "--quick" in sys.argv
+RESULTS = []
+
+
+def report(metric: str, value: float, unit: str) -> None:
+    base = BASELINES.get(metric)
+    rec = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / base, 3) if base else None,
+    }
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def timeit(fn, warmup: int = 1, trials: int = 3) -> float:
+    """Best-of-trials ops/s from fn() -> ops count."""
+    for _ in range(warmup):
+        fn()
+    best = 0.0
+    for _ in range(1 if QUICK else trials):
+        t0 = time.perf_counter()
+        n = fn()
+        dt = time.perf_counter() - t0
+        best = max(best, n / dt)
+    return best
+
+
+def main() -> None:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, max_workers=8)
+
+    @ray_tpu.remote
+    def nullary():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return b"ok"
+
+        def sink(self, *args):
+            return b"ok"
+
+    @ray_tpu.remote
+    class AsyncSink:
+        async def ping(self):
+            return b"ok"
+
+    # warm the worker pool so spawn latency isn't measured
+    ray_tpu.get([nullary.remote() for _ in range(16)])
+
+    N_SYNC = 200 if QUICK else 1000
+    N_ASYNC = 2000 if QUICK else 10000
+
+    def tasks_sync():
+        for _ in range(N_SYNC):
+            ray_tpu.get(nullary.remote())
+        return N_SYNC
+
+    report("single_client_tasks_sync", timeit(tasks_sync), "tasks/s")
+
+    def tasks_async():
+        ray_tpu.get([nullary.remote() for _ in range(N_ASYNC)])
+        return N_ASYNC
+
+    report("single_client_tasks_async", timeit(tasks_async), "tasks/s")
+
+    def tasks_multi():
+        with ThreadPoolExecutor(4) as pool:
+            list(pool.map(lambda _: ray_tpu.get(
+                [nullary.remote() for _ in range(N_ASYNC // 4)]), range(4)))
+        return N_ASYNC
+
+    report("multi_client_tasks_async", timeit(tasks_multi), "tasks/s")
+
+    # ---- actors
+    a = Sink.remote()
+    ray_tpu.get(a.ping.remote())
+
+    def actor_sync():
+        for _ in range(N_SYNC):
+            ray_tpu.get(a.ping.remote())
+        return N_SYNC
+
+    report("1_1_actor_calls_sync", timeit(actor_sync), "calls/s")
+
+    def actor_async():
+        ray_tpu.get([a.ping.remote() for _ in range(N_ASYNC)])
+        return N_ASYNC
+
+    report("1_1_actor_calls_async", timeit(actor_async), "calls/s")
+
+    conc = Sink.options(max_concurrency=4).remote()
+    ray_tpu.get(conc.ping.remote())
+
+    def actor_concurrent():
+        ray_tpu.get([conc.ping.remote() for _ in range(N_ASYNC)])
+        return N_ASYNC
+
+    report("1_1_actor_calls_concurrent", timeit(actor_concurrent), "calls/s")
+
+    n_actors = 4
+    actors = [Sink.remote() for _ in range(n_actors)]
+    ray_tpu.get([x.ping.remote() for x in actors])
+
+    def one_n_async():
+        refs = []
+        for i in range(N_ASYNC):
+            refs.append(actors[i % n_actors].ping.remote())
+        ray_tpu.get(refs)
+        return N_ASYNC
+
+    report("1_n_actor_calls_async", timeit(one_n_async), "calls/s")
+
+    def n_n_async():
+        with ThreadPoolExecutor(n_actors) as pool:
+            list(pool.map(
+                lambda x: ray_tpu.get(
+                    [x.ping.remote() for _ in range(N_ASYNC // n_actors)]),
+                actors))
+        return N_ASYNC
+
+    report("n_n_actor_calls_async", timeit(n_n_async), "calls/s")
+
+    arg = np.zeros(1024 * 1024, dtype=np.uint8)  # 1 MiB like the reference
+    arg_ref = ray_tpu.put(arg)
+    N_ARG = N_ASYNC // 10
+
+    def n_n_with_arg():
+        with ThreadPoolExecutor(n_actors) as pool:
+            list(pool.map(
+                lambda x: ray_tpu.get(
+                    [x.sink.remote(arg_ref) for _ in range(N_ARG // n_actors)]),
+                actors))
+        return N_ARG
+
+    report("n_n_actor_calls_with_arg_async", timeit(n_n_with_arg), "calls/s")
+
+    aa = AsyncSink.remote()
+    ray_tpu.get(aa.ping.remote())
+
+    def async_actor_sync():
+        for _ in range(N_SYNC):
+            ray_tpu.get(aa.ping.remote())
+        return N_SYNC
+
+    report("1_1_async_actor_calls_sync", timeit(async_actor_sync), "calls/s")
+
+    def async_actor_async():
+        ray_tpu.get([aa.ping.remote() for _ in range(N_ASYNC)])
+        return N_ASYNC
+
+    report("1_1_async_actor_calls_async", timeit(async_actor_async), "calls/s")
+
+    # ---- object store
+    small = b"x" * 1024
+    small_ref = ray_tpu.put(small)
+
+    def get_calls():
+        for _ in range(N_SYNC):
+            ray_tpu.get(small_ref)
+        return N_SYNC
+
+    # note: reference's get benchmark re-gets the same object too
+    report("single_client_get_calls", timeit(get_calls), "ops/s")
+
+    def put_calls():
+        for _ in range(N_SYNC):
+            ray_tpu.put(small)
+        return N_SYNC
+
+    report("single_client_put_calls", timeit(put_calls), "ops/s")
+
+    big = np.random.randint(0, 256, (256 * 1024 * 1024,), dtype=np.uint8)
+
+    def put_gb():
+        # free between puts: sustained throughput with the object
+        # lifecycle, not unbounded tmpfs accumulation (this sandbox
+        # throttles fresh-page allocation past ~1.2 GB)
+        n = 2 if QUICK else 4
+        for _ in range(n):
+            ray_tpu.free([ray_tpu.put(big)])
+        return n * big.nbytes / (1024**3)
+
+    report("single_client_put_gigabytes", timeit(put_gb, warmup=0), "GiB/s")
+
+    def wait_1k():
+        n = 2 if QUICK else 5
+        for _ in range(n):
+            refs = [nullary.remote() for _ in range(1000)]
+            ray_tpu.wait(refs, num_returns=1000, timeout=60)
+        return n
+
+    report("single_client_wait_1k_refs", timeit(wait_1k, warmup=0), "ops/s")
+
+    # ---- placement groups
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    def pg_churn():
+        n = 50 if QUICK else 200
+        for _ in range(n):
+            pg = placement_group([{"CPU": 0.01}])
+            pg.wait(10)
+            remove_placement_group(pg)
+        return n
+
+    report("placement_group_create_removal", timeit(pg_churn, warmup=0), "pg/s")
+
+    ray_tpu.shutdown()
+    ratios = [r["vs_baseline"] for r in RESULTS if r["vs_baseline"]]
+    geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
+    print(json.dumps({
+        "metric": "core_microbench_geomean_vs_baseline",
+        "value": round(geomean, 3),
+        "unit": "ratio",
+        "vs_baseline": round(geomean, 3),
+        "detail": {r["metric"]: r["value"] for r in RESULTS},
+    }))
+
+
+if __name__ == "__main__":
+    main()
