@@ -72,6 +72,7 @@ class ObjEntry:
     payload: Any = None
     size: int = 0
     node_id: str = "node0"  # producer node (VAL_SHM segments live there)
+    spilled: bool = False  # primary copy moved to disk (LRU eviction)
     # (conn, req_id) waiters registered by pending GETs
     task_waiters: List[bytes] = field(default_factory=list)  # task_ids blocked on this obj
 
@@ -95,6 +96,10 @@ class NodeEntry:
     agent_conn: Any = None  # None => head node (hub-local spawning)
     alive: bool = True
     spawning: int = 0
+    # shm object-store budget (reference: plasma eviction_policy.h LRU +
+    # external_storage.py spilling): bytes of live segments vs the cap
+    store_cap: float = 0.0  # 0 = unlimited
+    store_used: float = 0.0
 
 
 @dataclass
@@ -192,8 +197,18 @@ class Hub:
         worker_env: Optional[Dict[str, str]] = None,
         tcp: bool = False,
         host: str = "127.0.0.1",
+        object_store_memory: Optional[float] = None,
     ):
         import socket as _socket
+        import tempfile as _tempfile
+
+        if object_store_memory is None:
+            object_store_memory = float(
+                os.environ.get("RAY_TPU_OBJECT_STORE_MEMORY", 0)
+            )
+        self.spill_dir = os.environ.get("RAY_TPU_SPILL_DIR") or os.path.join(
+            _tempfile.gettempdir(), "ray_tpu_spill_" + os.path.basename(session_dir)
+        )
 
         self.session_dir = session_dir
         os.makedirs(session_dir, exist_ok=True)
@@ -218,9 +233,14 @@ class Hub:
             free_tpu_chips=set(tpu_chip_ids or []),
             max_workers=self.max_workers,
             agent_conn=None,
+            store_cap=object_store_memory,
         )
         self.nodes: Dict[str, NodeEntry] = {"node0": head}
         self.agent_conns: Dict[Any, str] = {}  # agent conn -> node_id
+        # per-node LRU of live shm segments (oid -> size), oldest first
+        from collections import OrderedDict as _OD
+
+        self._lru: Dict[str, "_OD[bytes, int]"] = {"node0": _OD()}
 
         self.objects: Dict[bytes, ObjEntry] = {}
         self.functions: Dict[str, bytes] = {}
@@ -386,6 +406,7 @@ class Hub:
             free_tpu_chips=set(p.get("tpu_chip_ids", [])),
             max_workers=p.get("max_workers") or 4,
             agent_conn=conn,
+            store_cap=float(p.get("store_cap") or 0),
         )
         self.nodes[node.node_id] = node
         self.agent_conns[conn] = node.node_id
@@ -431,6 +452,8 @@ class Hub:
             return
         e.ready, e.kind, e.payload, e.size = True, kind, payload, size
         e.node_id = node_id
+        if kind == P.VAL_SHM and size > 0:
+            self._account_segment(oid, e)
         # unblock task dependencies
         for spec in self.dep_waiters.pop(oid, []):
             spec.deps_remaining -= 1
@@ -461,11 +484,75 @@ class Hub:
             self._check_wait(req)
         self._dispatch()
 
+    # ---- shm budget: LRU accounting + disk spill (reference: plasma
+    # eviction_policy.h + _private/external_storage.py:72 filesystem spill)
+    def _account_segment(self, oid: bytes, e: ObjEntry):
+        node = self.nodes.get(e.node_id)
+        if node is None:
+            return
+        lru = self._lru.setdefault(e.node_id, __import__("collections").OrderedDict())
+        if oid not in lru:
+            node.store_used += e.size
+        lru[oid] = e.size
+        lru.move_to_end(oid)
+        self._maybe_spill(node)
+
+    def _touch_segment(self, oid: bytes, e: ObjEntry):
+        lru = self._lru.get(e.node_id)
+        if lru is not None and oid in lru:
+            lru.move_to_end(oid)
+
+    def _drop_segment_accounting(self, oid: bytes, e: ObjEntry):
+        lru = self._lru.get(e.node_id)
+        if lru is not None:
+            size = lru.pop(oid, None)
+            if size is not None:
+                node = self.nodes.get(e.node_id)
+                if node is not None:
+                    node.store_used = max(0.0, node.store_used - size)
+
+    def _maybe_spill(self, node: NodeEntry):
+        if node.store_cap <= 0 or node.store_used <= node.store_cap:
+            return
+        lru = self._lru.get(node.node_id)
+        if not lru:
+            return
+        # oldest-first until under the cap; never spill the newest entry
+        # (it may be the object being created right now)
+        victims = []
+        for oid in list(lru.keys())[:-1]:
+            if node.store_used <= node.store_cap:
+                break
+            size = lru.pop(oid)
+            node.store_used = max(0.0, node.store_used - size)
+            victims.append(oid)
+        for oid in victims:
+            e = self.objects.get(oid)
+            if e is None or e.spilled:
+                continue
+            e.spilled = True
+            if node.agent_conn is None:
+                os.makedirs(self.spill_dir, exist_ok=True)
+                src = os.path.join(node.session_dir, "objects", e.payload)
+                try:
+                    import shutil as _sh
+
+                    # shutil.move: tmpfs -> disk crosses filesystems, where
+                    # os.replace would raise EXDEV
+                    _sh.move(src, os.path.join(self.spill_dir, e.payload))
+                except OSError as err:
+                    sys.stderr.write(f"[ray_tpu] spill failed: {err}\n")
+                    e.spilled = False
+            else:
+                self._send(node.agent_conn, "obj_spill", {"name": e.payload})
+
     def _fulfill_get(self, req: GetReq):
         req.done = True
         values = []
         for oid in req.all_ids:
             e = self.objects[oid]
+            if e.kind == P.VAL_SHM:
+                self._touch_segment(oid, e)
             values.append((oid, e.kind, e.payload))
         self._reply(req.conn, req.req_id, values=values)
 
@@ -485,13 +572,40 @@ class Hub:
             def expire(req=req):
                 if not req.done:
                     req.done = True
+                    self._unregister_get_waiter(req)
                     self._reply(req.conn, req.req_id, timeout=True)
             self._add_timer(timeout, expire)
+
+    def _unregister_get_waiter(self, req: GetReq):
+        """Expired GETs must leave the per-object waiter lists, or
+        requests on never-created objects accumulate forever (r1 Weak
+        finding: hub waiter leak)."""
+        for oid in req.remaining:
+            lst = self.obj_get_waiters.get(oid)
+            if lst is not None:
+                try:
+                    lst.remove(req)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self.obj_get_waiters[oid]
+
+    def _unregister_wait_waiter(self, req: WaitReq):
+        for oid in req.ids:
+            lst = self.obj_wait_waiters.get(oid)
+            if lst is not None:
+                try:
+                    lst.remove(req)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self.obj_wait_waiters[oid]
 
     def _check_wait(self, req: WaitReq):
         ready = [oid for oid in req.ids if self.objects.get(oid) and self.objects[oid].ready]
         if len(ready) >= req.num_returns:
             req.done = True
+            self._unregister_wait_waiter(req)
             ready = ready[: req.num_returns]
             rset = set(ready)
             self._reply(
@@ -522,6 +636,7 @@ class Hub:
             def expire(req=req):
                 if not req.done:
                     req.done = True
+                    self._unregister_wait_waiter(req)
                     ready = [o for o in req.ids if self.objects.get(o) and self.objects[o].ready]
                     rset = set(ready)
                     self._reply(
@@ -534,13 +649,17 @@ class Hub:
         for oid in p["object_ids"]:
             e = self.objects.pop(oid, None)
             if e and e.kind == P.VAL_SHM:
+                self._drop_segment_accounting(oid, e)
                 # unlink on EVERY node: cross-node fetches install copies
                 # under the same segment name on consumer hosts
-                path = os.path.join(self.session_dir, "objects", e.payload)
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+                for path in (
+                    os.path.join(self.session_dir, "objects", e.payload),
+                    os.path.join(self.spill_dir, e.payload),
+                ):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
                 for node in self.nodes.values():
                     if node.alive and node.agent_conn is not None:
                         self._send(node.agent_conn, P.OBJ_UNLINK,
@@ -561,8 +680,33 @@ class Hub:
             self._reply(conn, p["req_id"], data=None,
                         error=f"object lost: node {e.node_id} is gone")
             return
+        same_node = self._conn_node(conn) == e.node_id
+        if e.spilled and same_node:
+            # the consumer will reinstall the segment into this node's
+            # shm anyway — restore it under accounting (possibly spilling
+            # colder objects) so the cap stays authoritative
+            if node.agent_conn is None:
+                try:
+                    import shutil as _sh
+
+                    _sh.move(
+                        os.path.join(self.spill_dir, e.payload),
+                        os.path.join(node.session_dir, "objects", e.payload),
+                    )
+                    e.spilled = False
+                except OSError:
+                    pass
+            else:
+                self._send(node.agent_conn, P.OBJ_RESTORE, {"name": e.payload})
+                e.spilled = False
+            if not e.spilled:
+                self._account_segment(p["object_id"], e)
         if node.agent_conn is None:
-            path = os.path.join(node.session_dir, "objects", e.payload)
+            path = os.path.join(
+                self.spill_dir if e.spilled else
+                os.path.join(node.session_dir, "objects"),
+                e.payload,
+            )
             try:
                 with open(path, "rb") as f:
                     data = f.read()

@@ -39,6 +39,12 @@ class NodeAgent:
         self.session_dir = os.environ["RAY_TPU_SESSION_DIR"]
         self.hostname = os.environ.get("RAY_TPU_NODE_HOSTNAME") or socket.gethostname()
         self.ip = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+        import tempfile
+
+        self.spill_dir = os.environ.get("RAY_TPU_SPILL_DIR") or os.path.join(
+            tempfile.gettempdir(),
+            "ray_tpu_spill_" + os.path.basename(self.session_dir),
+        )
         os.makedirs(os.path.join(self.session_dir, "objects"), exist_ok=True)
         self.children: Dict[str, subprocess.Popen] = {}
         self.conn = connect_hub(self.hub_addr)
@@ -66,6 +72,9 @@ class NodeAgent:
                 "max_workers": int(
                     os.environ.get("RAY_TPU_MAX_WORKERS")
                     or max(4, int(resources["CPU"]))
+                ),
+                "store_cap": float(
+                    os.environ.get("RAY_TPU_OBJECT_STORE_MEMORY", 0)
                 ),
             },
         )
@@ -103,6 +112,8 @@ class NodeAgent:
             self.children[p["env"]["RAY_TPU_WORKER_ID"]] = proc
         elif msg_type == P.OBJ_READ:
             path = os.path.join(self.session_dir, "objects", p["name"])
+            if not os.path.exists(path):
+                path = os.path.join(self.spill_dir, p["name"])  # spilled
             try:
                 with open(path, "rb") as f:
                     data = f.read()
@@ -114,8 +125,34 @@ class NodeAgent:
                     {"fetch_id": p["fetch_id"], "data": None, "error": str(err)},
                 )
         elif msg_type == P.OBJ_UNLINK:
+            for path in (
+                os.path.join(self.session_dir, "objects", p["name"]),
+                os.path.join(self.spill_dir, p["name"]),
+            ):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        elif msg_type == P.OBJ_SPILL:
+            import shutil
+
+            os.makedirs(self.spill_dir, exist_ok=True)
             try:
-                os.unlink(os.path.join(self.session_dir, "objects", p["name"]))
+                # tmpfs -> disk crosses filesystems (os.replace => EXDEV)
+                shutil.move(
+                    os.path.join(self.session_dir, "objects", p["name"]),
+                    os.path.join(self.spill_dir, p["name"]),
+                )
+            except OSError:
+                pass
+        elif msg_type == P.OBJ_RESTORE:
+            import shutil
+
+            try:
+                shutil.move(
+                    os.path.join(self.spill_dir, p["name"]),
+                    os.path.join(self.session_dir, "objects", p["name"]),
+                )
             except OSError:
                 pass
         elif msg_type == P.KILL:

@@ -48,6 +48,8 @@ WORKER_EXITED = "worker_exited"    # agent -> hub: child died pre-connect
 OBJ_READ = "obj_read"              # hub -> agent: read a shm segment
 OBJ_READ_REPLY = "obj_read_reply"  # agent -> hub: segment bytes
 OBJ_UNLINK = "obj_unlink"          # hub -> agent: free a shm segment
+OBJ_SPILL = "obj_spill"            # hub -> agent: move a segment to disk
+OBJ_RESTORE = "obj_restore"        # hub -> agent: move it back to shm
 FETCH_OBJECT = "fetch_object"      # client -> hub: pull a remote segment
 
 # hub -> worker

@@ -74,6 +74,7 @@ def init(
     ignore_reinit_error: bool = False,
     max_workers: Optional[int] = None,
     worker_env: Optional[Dict[str, str]] = None,
+    object_store_memory: Optional[float] = None,
     **kwargs,
 ):
     """Start the single-host runtime (hub thread + on-demand worker pool)."""
@@ -113,6 +114,7 @@ def init(
             # (or simulated hosts in tests) can register
             tcp=bool(kwargs.get("_tcp_hub") or os.environ.get("RAY_TPU_TCP_HUB")),
             host=kwargs.get("_hub_host", "127.0.0.1"),
+            object_store_memory=object_store_memory,
         )
         _hub.start()
         _client = CoreClient(_hub.addr, _session_dir, role="driver", worker_id="driver")
@@ -133,6 +135,15 @@ def shutdown() -> None:
             _hub = None
         if _session_dir is not None:
             shutil.rmtree(_session_dir, ignore_errors=True)
+            import tempfile
+
+            shutil.rmtree(
+                os.path.join(
+                    tempfile.gettempdir(),
+                    "ray_tpu_spill_" + os.path.basename(_session_dir),
+                ),
+                ignore_errors=True,
+            )
             _session_dir = None
         try:
             atexit.unregister(shutdown)

@@ -1,0 +1,132 @@
+"""Object-store lifecycle: LRU eviction under a configurable cap with
+disk spill/restore (reference: plasma eviction_policy.h LRU +
+_private/external_storage.py filesystem spilling), plus the hub
+get/wait waiter-leak regression (r1 Weak #10)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+OBJ_MB = 4
+CAP_BYTES = 24 * 1024 * 1024  # room for ~5 segments
+
+
+@pytest.fixture
+def capped_runtime():
+    ctx = ray_tpu.init(
+        num_cpus=2, max_workers=2, object_store_memory=CAP_BYTES
+    )
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _session_objects_bytes():
+    sdir = ray_tpu._private.worker._session_dir
+    odir = os.path.join(sdir, "objects")
+    return sum(
+        os.path.getsize(os.path.join(odir, f)) for f in os.listdir(odir)
+    )
+
+
+def test_create_2x_cap_completes_and_stays_bounded(capped_runtime):
+    """2x the cap of live objects: puts keep succeeding, shm stays at
+    ~cap (cold segments spill to disk), every value remains readable."""
+    n = 2 * CAP_BYTES // (OBJ_MB * 1024 * 1024)
+    refs = []
+    for i in range(n):
+        arr = np.full((OBJ_MB * 1024 * 1024 // 8,), float(i))
+        refs.append(ray_tpu.put(arr))
+    hub = ray_tpu._private.worker._hub
+    assert hub.nodes["node0"].store_used <= CAP_BYTES
+    assert _session_objects_bytes() <= CAP_BYTES + OBJ_MB * 1024 * 1024
+    spilled = [
+        e for e in hub.objects.values() if e.spilled
+    ]
+    assert spilled, "expected cold segments to spill"
+    # every object still readable — including spilled ones (restore path).
+    # Read via a fresh worker process (its local store has none of the
+    # driver's cached mmaps).
+
+    @ray_tpu.remote
+    def first(x):
+        return float(x[0])
+
+    for i, ref in enumerate(refs):
+        assert ray_tpu.get(first.remote(ref)) == float(i)
+
+
+def test_spilled_object_direct_get_restores(capped_runtime):
+    big = np.arange(CAP_BYTES // 2 // 8, dtype=np.float64)
+    ref0 = ray_tpu.put(big)
+    hub = ray_tpu._private.worker._hub
+    oid0 = ref0._id.binary()
+    # push it out with newer objects
+    keep = [ray_tpu.put(np.zeros(CAP_BYTES // 2 // 8)) for _ in range(3)]
+    assert hub.objects[oid0].spilled
+    # driver get (same node): hub restores the segment under accounting
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(ref0)) == float(big.sum())
+    assert not hub.objects[oid0].spilled
+    assert hub.nodes["node0"].store_used <= CAP_BYTES
+
+
+def test_free_cleans_spill_files(capped_runtime):
+    refs = [
+        ray_tpu.put(np.zeros(OBJ_MB * 1024 * 1024 // 8)) for _ in range(10)
+    ]
+    hub = ray_tpu._private.worker._hub
+    assert any(e.spilled for e in hub.objects.values())
+    ray_tpu.free(refs)
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not os.path.isdir(hub.spill_dir) or not os.listdir(hub.spill_dir):
+            break
+        time.sleep(0.05)
+    assert not os.path.isdir(hub.spill_dir) or not os.listdir(hub.spill_dir)
+    assert hub.nodes["node0"].store_used == 0
+
+
+def test_get_timeout_unregisters_waiter(capped_runtime):
+    from ray_tpu.object_ref import ObjectRef
+    from ray_tpu._private.ids import ObjectID
+
+    hub = ray_tpu._private.worker._hub
+    ghost = ObjectRef(ObjectID.generate())
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(ghost, timeout=0.2)
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not hub.obj_get_waiters.get(ghost._id.binary()):
+            break
+        time.sleep(0.05)
+    assert not hub.obj_get_waiters.get(ghost._id.binary())
+
+
+def test_wait_timeout_unregisters_waiter(capped_runtime):
+    from ray_tpu.object_ref import ObjectRef
+    from ray_tpu._private.ids import ObjectID
+
+    hub = ray_tpu._private.worker._hub
+    ghost = ObjectRef(ObjectID.generate())
+    ready, not_ready = ray_tpu.wait([ghost], num_returns=1, timeout=0.2)
+    assert not ready and len(not_ready) == 1
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not hub.obj_wait_waiters.get(ghost._id.binary()):
+            break
+        time.sleep(0.05)
+    assert not hub.obj_wait_waiters.get(ghost._id.binary())
