@@ -29,10 +29,11 @@ from ._private.worker import (
     nodes,
     put,
     shutdown,
+    timeline,
     wait,
 )
 from .actor import ActorClass, ActorHandle
-from .object_ref import ObjectRef
+from .object_ref import ObjectRef, ObjectRefGenerator
 from .remote_function import RemoteFunction
 
 __version__ = "0.1.0"
@@ -86,6 +87,8 @@ __all__ = [
     "cluster_resources",
     "nodes",
     "ObjectRef",
+    "ObjectRefGenerator",
+    "timeline",
     "ActorClass",
     "ActorHandle",
     "RemoteFunction",

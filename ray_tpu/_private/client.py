@@ -271,7 +271,8 @@ class CoreClient:
         num_returns: int,
         resources: Dict[str, float],
         options: dict,
-    ) -> List[ObjectID]:
+        return_task_id: bool = False,
+    ):
         task_id = TaskID.generate()
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
         self.send_async(
@@ -287,6 +288,8 @@ class CoreClient:
                 "options": options,
             },
         )
+        if return_task_id:
+            return task_id.binary(), return_ids
         return return_ids
 
     def create_actor(
@@ -329,7 +332,8 @@ class CoreClient:
         arg_dep_ids: List[bytes],
         num_returns: int,
         options: dict,
-    ) -> List[ObjectID]:
+        return_task_id: bool = False,
+    ):
         task_id = TaskID.generate()
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
         self.send_async(
@@ -345,6 +349,8 @@ class CoreClient:
                 "options": options,
             },
         )
+        if return_task_id:
+            return task_id.binary(), return_ids
         return return_ids
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:

@@ -168,6 +168,20 @@ class PGEntry:
 
 
 @dataclass
+class StreamEntry:
+    """State of one streaming-generator task (reference:
+    core_worker streaming generator + ObjectRefGenerator _raylet.pyx:280):
+    yielded object ids in order, consumer cursor for backpressure, and
+    waiters blocked on indices not yet produced."""
+
+    oids: List[bytes] = field(default_factory=list)
+    ended: bool = False
+    consumed: int = 0
+    next_waiters: Dict[int, List[Tuple[Any, int]]] = field(default_factory=dict)
+    credit_waiters: List[Tuple[int, Any, int]] = field(default_factory=list)
+
+
+@dataclass
 class GetReq:
     conn: Any
     req_id: int
@@ -266,6 +280,14 @@ class Hub:
         self._fetch_seq = itertools.count()
         self._pending_fetches: Dict[int, Tuple[Any, int]] = {}
         self._spawn_wants: Dict[str, int] = {}
+        self.streams: Dict[bytes, StreamEntry] = {}
+        # observability plane (reference: stats/metric.h registry +
+        # core_worker/task_event_buffer.h -> GCS task events)
+        self.metrics: Dict[Tuple[str, tuple], dict] = {}
+        self.task_events: deque = deque(maxlen=int(
+            os.environ.get("RAY_TPU_TASK_EVENTS_MAX", 20000)
+        ))
+        self._task_event_index: Dict[bytes, dict] = {}
         self.client_conns: List[Any] = []
         self.driver_conn = None
         self._running = True
@@ -736,6 +758,124 @@ class Hub:
             self._reply(conn, req_id, data=None,
                         error=f"object lost: node {node_id} died mid-fetch")
 
+    # ----- streaming generators
+    def _stream(self, task_id: bytes) -> StreamEntry:
+        s = self.streams.get(task_id)
+        if s is None:
+            s = self.streams[task_id] = StreamEntry()
+        return s
+
+    def _on_stream_yield(self, conn, p):
+        s = self._stream(p["task_id"])
+        idx = len(s.oids)
+        self._object_ready(
+            p["object_id"], p["kind"], p["payload"], p.get("size", 0),
+            node_id=self._conn_node(conn),
+        )
+        s.oids.append(p["object_id"])
+        for wconn, req_id in s.next_waiters.pop(idx, []):
+            s.consumed = max(s.consumed, idx + 1)
+            self._reply(wconn, req_id, object_id=p["object_id"])
+        self._wake_credit_waiters(s)
+
+    def _on_stream_end(self, conn, p):
+        s = self._stream(p["task_id"])
+        if p.get("error") is not None:
+            # the N+1-th ref carries the error (reference semantics)
+            from .ids import ObjectID
+
+            err_oid = ObjectID.generate().binary()
+            self._object_ready(err_oid, P.VAL_ERROR, p["error"], 0)
+            idx = len(s.oids)
+            s.oids.append(err_oid)
+            for wconn, req_id in s.next_waiters.pop(idx, []):
+                self._reply(wconn, req_id, object_id=err_oid)
+        s.ended = True
+        for idx, waiters in list(s.next_waiters.items()):
+            if idx >= len(s.oids):
+                for wconn, req_id in waiters:
+                    self._reply(wconn, req_id, end=True)
+                del s.next_waiters[idx]
+        # release any backpressured producer (it is done anyway)
+        self._wake_credit_waiters(s, force=True)
+
+    def _end_stream_with_error(self, task_id: bytes, err_blob) -> None:
+        s = self.streams.get(task_id)
+        if s is None or s.ended:
+            return
+        self._on_stream_end(None, {"task_id": task_id, "error": err_blob})
+
+    def _on_stream_next(self, conn, p):
+        s = self._stream(p["task_id"])
+        idx = p["index"]
+        if idx < len(s.oids):
+            s.consumed = max(s.consumed, idx + 1)
+            self._reply(conn, p["req_id"], object_id=s.oids[idx])
+            self._wake_credit_waiters(s)
+        elif s.ended:
+            self._reply(conn, p["req_id"], end=True)
+        else:
+            s.next_waiters.setdefault(idx, []).append((conn, p["req_id"]))
+
+    def _on_stream_credit(self, conn, p):
+        s = self._stream(p["task_id"])
+        if s.consumed >= p["min_consumed"] or s.ended:
+            self._reply(conn, p["req_id"], ok=True)
+        else:
+            s.credit_waiters.append((p["min_consumed"], conn, p["req_id"]))
+
+    def _wake_credit_waiters(self, s: StreamEntry, force: bool = False):
+        still = []
+        for min_consumed, conn, req_id in s.credit_waiters:
+            if force or s.consumed >= min_consumed:
+                self._reply(conn, req_id, ok=True)
+            else:
+                still.append((min_consumed, conn, req_id))
+        s.credit_waiters = still
+
+    # ----- metrics registry (reference: src/ray/stats/metric.h:104)
+    def _on_metric_record(self, conn, p):
+        key = (p["name"], p["tags"])
+        m = self.metrics.get(key)
+        if m is None:
+            m = self.metrics[key] = {
+                "name": p["name"],
+                "type": p["type"],
+                "description": p.get("description", ""),
+                "tags": p["tags"],
+                "value": 0.0,
+                "sum": 0.0,
+                "count": 0,
+                "buckets": [[b, 0] for b in p.get("boundaries", ())],
+            }
+        op = p["op"]
+        if op == "add":
+            m["value"] += p["value"]
+        elif op == "set":
+            m["value"] = p["value"]
+        elif op == "observe":
+            m["sum"] += p["value"]
+            m["count"] += 1
+            for pair in m["buckets"]:
+                if p["value"] <= pair[0]:
+                    pair[1] += 1
+                    break
+
+    # ----- task events (reference: core_worker/task_event_buffer.h;
+    # feeds list_state("tasks") + the chrome-trace timeline)
+    def _task_event(self, task_id: bytes, **fields):
+        ev = self._task_event_index.get(task_id)
+        if ev is None:
+            ev = {"task_id": task_id.hex()}
+            self._task_event_index[task_id] = ev
+            self.task_events.append(ev)
+            if len(self._task_event_index) > self.task_events.maxlen:
+                # index follows the deque's eviction approximately
+                drop = len(self._task_event_index) - self.task_events.maxlen
+                for k in list(self._task_event_index)[:drop]:
+                    del self._task_event_index[k]
+        ev.update(fields)
+
     # ----- functions
     def _on_register_function(self, conn, p):
         self.functions[p["fn_id"]] = p["blob"]
@@ -787,6 +927,11 @@ class Hub:
                 self.dep_waiters.setdefault(dep, []).append(spec)
         spec.deps_remaining = pending
         self.tasks[spec.task_id] = spec
+        self._task_event(
+            spec.task_id, name=spec.fn_id or (spec.method or ""),
+            state="PENDING_ARGS" if pending else "PENDING_SCHEDULING",
+            submitted_at=time.time(),
+        )
         if pending == 0:
             self._enqueue_runnable(spec)
 
@@ -995,6 +1140,10 @@ class Hub:
         worker.state = "busy"
         worker.current_task = spec
         worker.tpu_chips = chips
+        self._task_event(
+            spec.task_id, state="RUNNING", started_at=time.time(),
+            worker_id=worker.worker_id, node_id=worker.node_id,
+        )
         fn_blob = None
         if spec.fn_id not in worker.seen_fns:
             fn_blob = self.functions.get(spec.fn_id)
@@ -1013,7 +1162,11 @@ class Hub:
                 "tpu_chips": chips,
                 "actor_id": spec.actor_id,
                 "ready_id": spec.ready_id,
-                "options": {k: v for k, v in spec.options.items() if k in ("max_concurrency",)},
+                "options": {
+                    k: v for k, v in spec.options.items()
+                    if k in ("max_concurrency", "streaming",
+                             "_generator_backpressure_num_objects")
+                },
             },
         )
 
@@ -1105,6 +1258,11 @@ class Hub:
             if actor is not None:
                 actor.inflight.pop(p["task_id"], None)
         node_id = worker.node_id if worker is not None else "node0"
+        failed = any(kind == P.VAL_ERROR for _, kind, _, _ in p["returns"])
+        self._task_event(
+            p["task_id"], state="FAILED" if failed else "FINISHED",
+            finished_at=time.time(),
+        )
         for oid, kind, payload, size in p["returns"]:
             self._object_ready(oid, kind, payload, size, node_id=node_id)
         self._dispatch()
@@ -1131,6 +1289,10 @@ class Hub:
             self._object_ready(oid, P.VAL_ERROR, blob, 0)
         if spec.ready_id:
             self._object_ready(spec.ready_id, P.VAL_ERROR, blob, 0)
+        if spec.options.get("streaming"):
+            self._end_stream_with_error(spec.task_id, blob)
+        self._task_event(spec.task_id, state="FAILED", finished_at=time.time(),
+                         error=str(err)[:200])
         self.tasks.pop(spec.task_id, None)
 
     # ----- actors
@@ -1250,6 +1412,11 @@ class Hub:
             actor.pending_calls.append(spec)
             return
         actor.inflight[spec.task_id] = spec
+        self._task_event(
+            spec.task_id, name=spec.method or "", state="RUNNING",
+            started_at=time.time(), worker_id=worker.worker_id,
+            node_id=worker.node_id, actor_id=actor.actor_id.hex(),
+        )
         self._send(
             worker.conn,
             P.EXEC_ACTOR_TASK,
@@ -1260,6 +1427,10 @@ class Hub:
                 "args_kind": spec.args_kind,
                 "args_payload": spec.args_payload,
                 "return_ids": spec.return_ids,
+                "options": {
+                    k: v for k, v in spec.options.items()
+                    if k in ("streaming", "_generator_backpressure_num_objects")
+                },
             },
         )
 
@@ -1271,9 +1442,13 @@ class Hub:
             spec = actor.pending_calls.popleft()
             for oid in spec.return_ids:
                 self._object_ready(oid, P.VAL_ERROR, blob, 0)
+            if spec.options.get("streaming"):
+                self._end_stream_with_error(spec.task_id, blob)
         for spec in actor.inflight.values():
             for oid in spec.return_ids:
                 self._object_ready(oid, P.VAL_ERROR, blob, 0)
+            if spec.options.get("streaming"):
+                self._end_stream_with_error(spec.task_id, blob)
         actor.inflight.clear()
 
     def _on_kill_actor(self, conn, p):
@@ -1402,6 +1577,8 @@ class Hub:
                     for s in actor.inflight.values():
                         for oid in s.return_ids:
                             self._object_ready(oid, P.VAL_ERROR, blob, 0)
+                        if s.options.get("streaming"):
+                            self._end_stream_with_error(s.task_id, blob)
                     actor.inflight.clear()
                     respawn = TaskSpec(
                         task_id=actor.actor_id,
@@ -1586,8 +1763,28 @@ class Hub:
                     "pid": w.proc.pid if w.proc else None,
                 })
         elif kind == "tasks":
-            for t in self.tasks.values():
-                items.append({"task_id": t.task_id.hex(), "fn_id": t.fn_id})
+            items = list(self.task_events)
+        elif kind == "metrics":
+            for m in self.metrics.values():
+                items.append(dict(m, buckets=[list(b) for b in m["buckets"]]))
+        elif kind == "timeline":
+            # chrome://tracing "complete" events (reference: ray.timeline
+            # via GCS task events -> chrome trace)
+            for ev in self.task_events:
+                if "started_at" not in ev:
+                    continue
+                end = ev.get("finished_at") or time.time()
+                items.append({
+                    "name": ev.get("name", ""),
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": ev["started_at"] * 1e6,
+                    "dur": max(0.0, (end - ev["started_at"]) * 1e6),
+                    "pid": ev.get("node_id", "node0"),
+                    "tid": ev.get("worker_id", ""),
+                    "args": {"task_id": ev["task_id"],
+                             "state": ev.get("state")},
+                })
         elif kind == "placement_groups":
             for g in self.pgs.values():
                 items.append(

@@ -40,6 +40,12 @@ SHUTDOWN = "shutdown"
 TASK_DONE = "task_done"
 ACTOR_READY = "actor_ready"
 
+# streaming generators (reference: _raylet.pyx:280 ObjectRefGenerator)
+STREAM_YIELD = "stream_yield"    # worker -> hub: one yielded value
+STREAM_END = "stream_end"        # worker -> hub: generator exhausted/raised
+STREAM_NEXT = "stream_next"      # client -> hub: resolve the i-th ref
+STREAM_CREDIT = "stream_credit"  # worker -> hub: backpressure wait
+
 # node agent <-> hub (multi-host: one agent per host, reference analogue
 # src/ray/raylet/node_manager.h:122 registering with the GCS)
 REGISTER_NODE = "register_node"

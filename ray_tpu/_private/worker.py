@@ -245,3 +245,15 @@ def cluster_resources() -> Dict[str, float]:
 
 def nodes() -> List[dict]:
     return get_client().list_state("nodes")
+
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace task timeline (reference: ray.timeline) — open the
+    returned/saved JSON in chrome://tracing or Perfetto."""
+    events = get_client().list_state("timeline")
+    if filename:
+        import json
+
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

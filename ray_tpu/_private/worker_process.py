@@ -105,6 +105,66 @@ class WorkerRuntime:
             blob = dumps_inline(TaskError(fn_name, tb))
         return [(oid, P.VAL_ERROR, blob, 0) for oid in return_ids]
 
+    def _stream_yield_one(self, p: dict, idx: int, value) -> None:
+        from .ids import ObjectID
+
+        oid = ObjectID.generate()
+        kind, payload, size = self.client.encode_value(oid, value)
+        self.client.send(
+            P.STREAM_YIELD,
+            {
+                "task_id": p["task_id"],
+                "object_id": oid.binary(),
+                "kind": kind,
+                "payload": payload,
+                "size": size,
+            },
+        )
+
+    def _stream_results(self, p: dict, gen) -> None:
+        """Drive a generator task: yield values become incremental stream
+        objects (reference: streaming generator protocol, the worker
+        reports each return as it is produced, _raylet.pyx:280). The
+        TASK_DONE at the end frees the worker; the stream itself ends via
+        STREAM_END (error carried as the stream's final object)."""
+        import inspect
+
+        from .ids import ObjectID
+
+        task_id = p["task_id"]
+        bp = (p.get("options") or {}).get("_generator_backpressure_num_objects")
+        try:
+            idx = 0
+            for value in gen:
+                oid = ObjectID.generate()
+                kind, payload, size = self.client.encode_value(oid, value)
+                self.client.send(
+                    P.STREAM_YIELD,
+                    {
+                        "task_id": task_id,
+                        "object_id": oid.binary(),
+                        "kind": kind,
+                        "payload": payload,
+                        "size": size,
+                    },
+                )
+                idx += 1
+                if bp and idx >= bp:
+                    # wait until the consumer is within the window
+                    self.client.request(
+                        P.STREAM_CREDIT,
+                        {"task_id": task_id, "min_consumed": idx - bp + 1},
+                    )
+            self.client.send(P.STREAM_END, {"task_id": task_id, "error": None})
+        except Exception:
+            from ..exceptions import TaskError
+
+            err = TaskError("streaming_generator", traceback.format_exc())
+            self.client.send(
+                P.STREAM_END, {"task_id": task_id, "error": dumps_inline(err)}
+            )
+        self.client.send(P.TASK_DONE, {"task_id": task_id, "returns": []})
+
     # ------------------------------------------------------------ execution
     def exec_task(self, p: dict):
         if p.get("tpu_chips"):
@@ -115,6 +175,9 @@ class WorkerRuntime:
             fn_name = getattr(fn, "__name__", fn_name)
             args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
             result = fn(*args, **kwargs)
+            if (p.get("options") or {}).get("streaming"):
+                self._stream_results(p, result)
+                return
             returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
         except Exception:
             returns = self._error_returns(p["return_ids"], fn_name)
@@ -164,6 +227,9 @@ class WorkerRuntime:
                 method = getattr(self.actor_instance, method_name)
                 args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
                 result = method(*args, **kwargs)
+            if (p.get("options") or {}).get("streaming"):
+                self._stream_results(p, result)
+                return
             returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
         except Exception:
             returns = self._error_returns(p["return_ids"], method_name)
@@ -177,11 +243,46 @@ class WorkerRuntime:
         return self.aio_loop
 
     def exec_actor_task(self, p: dict):
+        import inspect
+
         method = getattr(type(self.actor_instance), p["method"], None) if p["method"] not in (
             "__ray_ready__",
             "__ray_terminate__",
         ) else None
-        if method is not None and asyncio.iscoroutinefunction(method):
+        if (
+            method is not None
+            and inspect.isasyncgenfunction(method)
+            and (p.get("options") or {}).get("streaming")
+        ):
+            loop = self._ensure_aio_loop()
+
+            async def run_stream():
+                try:
+                    args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
+                    agen = method(self.actor_instance, *args, **kwargs)
+                    items = []
+                    async for v in agen:
+                        items.append(v)
+                        # flush incrementally: one yield per item keeps
+                        # streaming semantics without a sync bridge
+                        self._stream_yield_one(p, len(items) - 1, v)
+                    self.client.send(
+                        P.STREAM_END, {"task_id": p["task_id"], "error": None}
+                    )
+                except Exception:
+                    from ..exceptions import TaskError
+
+                    err = TaskError(p["method"], traceback.format_exc())
+                    self.client.send(
+                        P.STREAM_END,
+                        {"task_id": p["task_id"], "error": dumps_inline(err)},
+                    )
+                self.client.send(
+                    P.TASK_DONE, {"task_id": p["task_id"], "returns": []}
+                )
+
+            asyncio.run_coroutine_threadsafe(run_stream(), loop)
+        elif method is not None and asyncio.iscoroutinefunction(method):
             loop = self._ensure_aio_loop()
 
             async def run():

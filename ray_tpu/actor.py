@@ -35,6 +35,26 @@ class ActorMethod:
         client = worker.get_client()
         args_kind, args_payload, deps = encode_args(client, args, kwargs)
         num_returns = self._options.get("num_returns", 1)
+        options = scheduling_options(self._options)
+        if num_returns == "streaming":
+            from .object_ref import ObjectRefGenerator
+
+            options["streaming"] = True
+            if self._options.get("_generator_backpressure_num_objects"):
+                options["_generator_backpressure_num_objects"] = self._options[
+                    "_generator_backpressure_num_objects"
+                ]
+            task_id, _ = client.submit_actor_task(
+                self._handle._actor_id,
+                self._name,
+                args_kind,
+                args_payload,
+                deps,
+                0,
+                options,
+                return_task_id=True,
+            )
+            return ObjectRefGenerator(task_id)
         return_ids = client.submit_actor_task(
             self._handle._actor_id,
             self._name,
@@ -42,7 +62,7 @@ class ActorMethod:
             args_payload,
             deps,
             num_returns,
-            scheduling_options(self._options),
+            options,
         )
         refs = [ObjectRef(r) for r in return_ids]
         return refs[0] if num_returns == 1 else refs

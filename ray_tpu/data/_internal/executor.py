@@ -273,7 +273,12 @@ class StreamingExecutor:
     # -- public -------------------------------------------------------
     def execute(self) -> Iterator[Any]:
         """Yield ObjectRefs of final blocks (each ref -> List[Block]-free
-        single Block)."""
+        single Block). Per-stage wall times and block counts accumulate
+        in ``self.stage_stats`` (reference: data/_internal/stats.py
+        per-operator DatasetStats behind ds.stats())."""
+        import time as _time
+
+        self.stage_stats: List[dict] = []
         stream: Iterator[Any] = iter(())
         for stage in self.stages:
             if stage.kind == "input":
@@ -286,7 +291,26 @@ class StreamingExecutor:
                 stream = self._run_limit(stage, stream)
             elif stage.kind == "all_to_all":
                 stream = self._run_all_to_all(stage, stream)
+            if stage.kind != "input":
+                stat = {"name": stage.name, "wall_s": 0.0, "blocks": 0}
+                self.stage_stats.append(stat)
+                stream = self._timed(stream, stat, _time)
         return stream
+
+    @staticmethod
+    def _timed(stream: Iterator[Any], stat: dict, _time) -> Iterator[Any]:
+        """Cumulative time spent pulling through this stage's iterator
+        (includes upstream; ds.stats() reports the self-time deltas)."""
+        while True:
+            t0 = _time.perf_counter()
+            try:
+                item = next(stream)
+            except StopIteration:
+                stat["wall_s"] += _time.perf_counter() - t0
+                return
+            stat["wall_s"] += _time.perf_counter() - t0
+            stat["blocks"] += 1
+            yield item
 
     # -- helpers ------------------------------------------------------
     def _ray(self):

@@ -154,7 +154,9 @@ class Dataset:
     def _block_refs(self) -> Iterator[Any]:
         if self._materialized is not None:
             return iter(self._materialized)
-        return StreamingExecutor(build_stages(self._logical)).execute()
+        executor = StreamingExecutor(build_stages(self._logical))
+        self._last_executor = executor
+        return executor.execute()
 
     def materialize(self) -> "Dataset":
         """Execute now; the result caches block refs (reference:
@@ -162,6 +164,7 @@ class Dataset:
         refs = list(self._block_refs())
         ds = Dataset(L.LogicalPlan(L.FromBlocks(blocks=refs)))
         ds._materialized = refs
+        ds._last_executor = getattr(self, "_last_executor", None)
         return ds
 
     def iter_internal_refs(self) -> Iterator[Any]:
@@ -363,11 +366,30 @@ class Dataset:
 
     # ------------------------------------------------------------ misc
     def stats(self) -> str:
+        """Per-operator execution report (reference: Dataset.stats() /
+        data/_internal/stats.py). Wall times are self-times: each
+        stage's cumulative pull time minus its upstream's."""
         ops = [op.name for op in self._logical.ops()]
-        return f"Dataset(plan={' -> '.join(ops)})"
+        header = f"Dataset(plan={' -> '.join(ops)})"
+        executor = getattr(self, "_last_executor", None)
+        stage_stats = getattr(executor, "stage_stats", None) if executor else None
+        if not stage_stats:
+            return header + "\n  (not executed yet - run materialize() or iterate)"
+        lines = [header]
+        prev = 0.0
+        for s in stage_stats:
+            self_time = max(0.0, s["wall_s"] - prev)
+            prev = s["wall_s"]
+            lines.append(
+                f"  {s['name']}: {self_time * 1e3:.1f}ms self, "
+                f"{s['blocks']} blocks"
+            )
+        lines.append(f"  total: {prev * 1e3:.1f}ms")
+        return "\n".join(lines)
 
     def __repr__(self):
-        return self.stats()
+        ops = [op.name for op in self._logical.ops()]
+        return f"Dataset(plan={' -> '.join(ops)})"
 
 
 def _json_safe(v):

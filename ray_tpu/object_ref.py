@@ -67,3 +67,52 @@ class ObjectRef:
 
 def _rebuild_ref(id_bytes: bytes) -> ObjectRef:
     return ObjectRef(ObjectID(id_bytes))
+
+
+class ObjectRefGenerator:
+    """Incrementally-resolved refs from a `num_returns="streaming"` task.
+
+    Parity: the reference's ObjectRefGenerator (_raylet.pyx:280) — sync
+    and async iteration over ObjectRefs as the remote generator yields;
+    a mid-stream exception surfaces as a final ref whose get() raises.
+    """
+
+    def __init__(self, task_id: bytes):
+        self._task_id = task_id
+        self._idx = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from ._private import protocol as P
+        from ._private import worker
+
+        client = worker.get_client()
+        reply = client.request(
+            P.STREAM_NEXT, {"task_id": self._task_id, "index": self._idx}
+        )
+        if reply.get("end"):
+            raise StopIteration
+        self._idx += 1
+        return ObjectRef(ObjectID(reply["object_id"]))
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> ObjectRef:
+        import asyncio
+
+        def step():
+            try:
+                return self.__next__()
+            except StopIteration:
+                return None
+
+        ref = await asyncio.to_thread(step)
+        if ref is None:
+            raise StopAsyncIteration
+        return ref
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._task_id,))

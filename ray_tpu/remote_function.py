@@ -152,6 +152,23 @@ class RemoteFunction:
         num_returns = opts.get("num_returns", 1)
         resources = canonical_resources(opts, is_actor=False)
         options = scheduling_options(opts)
+        if num_returns == "streaming":
+            from .object_ref import ObjectRefGenerator
+
+            options["streaming"] = True
+            if opts.get("_generator_backpressure_num_objects"):
+                options["_generator_backpressure_num_objects"] = opts[
+                    "_generator_backpressure_num_objects"
+                ]
+            # a partially-consumed stream cannot be transparently
+            # re-executed; no retries (reference behaves likewise for
+            # yielded-and-consumed prefixes)
+            options["max_retries"] = 0
+            task_id, _ = client.submit_task(
+                fn_id, args_kind, args_payload, deps, 0, resources, options,
+                return_task_id=True,
+            )
+            return ObjectRefGenerator(task_id)
         options.setdefault("max_retries", opts.get("max_retries", 3))
         return_ids = client.submit_task(
             fn_id, args_kind, args_payload, deps, num_returns, resources, options

@@ -78,6 +78,30 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method_name: str, args: Tuple, kwargs: Dict):
+        """Generator variant: invoked with num_returns="streaming" so
+        each yielded chunk becomes an incremental stream object
+        (reference: Serve streaming responses over ObjectRefGenerator)."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            target = (
+                self.instance
+                if method_name == "__call__"
+                else getattr(self.instance, method_name)
+            )
+            result = target(*args, **kwargs)
+            if inspect.isgenerator(result):
+                yield from result
+            else:
+                if inspect.iscoroutine(result):
+                    result = _run_coro(result)
+                yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
 
 _loop: Optional[asyncio.AbstractEventLoop] = None
 _loop_lock = threading.Lock()

@@ -89,10 +89,31 @@ class DeploymentResponse:
         return _get().__await__()
 
 
+class DeploymentResponseGenerator:
+    """Iterates a streaming deployment call's yielded values (parity:
+    serve's DeploymentResponseGenerator over an ObjectRefGenerator)."""
+
+    def __init__(self, ref_gen):
+        self._ref_gen = ref_gen
+
+    def __iter__(self):
+        import ray_tpu
+
+        for ref in self._ref_gen:
+            yield ray_tpu.get(ref)
+
+    async def __aiter__(self):
+        import ray_tpu
+
+        async for ref in self._ref_gen:
+            yield await ref
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__"):
         self.deployment_name = deployment_name
         self.method_name = method_name
+        self._stream = False
         self._replicas: List[Any] = []
         self._outstanding: Dict[int, int] = {}
         self._inflight: Dict[Any, int] = {}  # ref -> replica id
@@ -105,11 +126,17 @@ class DeploymentHandle:
         return (DeploymentHandle, (self.deployment_name, self.method_name))
 
     # -- API -----------------------------------------------------------
-    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+    def options(
+        self,
+        *,
+        method_name: Optional[str] = None,
+        stream: Optional[bool] = None,
+    ) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, method_name or self.method_name)
         h._replicas = self._replicas
         h._outstanding = self._outstanding
         h._refreshed = self._refreshed
+        h._stream = self._stream if stream is None else stream
         return h
 
     def __getattr__(self, name: str):
@@ -178,6 +205,14 @@ class DeploymentHandle:
         rid = _rid(replica)
         with self._lock:
             self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
+        if self._stream:
+            # streamed responses flow as an ObjectRefGenerator; no
+            # transparent replica retry (a half-consumed stream is not
+            # transparently re-executable)
+            ref_gen = replica.handle_request_streaming.options(
+                num_returns="streaming"
+            ).remote(method, args, kwargs)
+            return DeploymentResponseGenerator(ref_gen)
         ref = replica.handle_request.remote(method, args, kwargs)
         with self._lock:
             self._inflight[ref] = rid

@@ -1,0 +1,154 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Parity: python/ray/util/metrics.py over the reference's OpenCensus
+registry (src/ray/stats/metric.h:104). TPU-native simplification: no
+sidecar exporter chain — metric records batch through the client's
+existing hub connection and aggregate in the hub's registry; scrape via
+``ray_tpu.util.metrics.snapshot()`` or render with
+``prometheus_text()`` for a /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_HIST_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0
+]
+
+
+class Metric:
+    """Base: name + default tags; subclasses choose the aggregation."""
+
+    _TYPE = "none"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        tag_keys: Optional[Sequence[str]] = None,
+    ):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    @property
+    def info(self) -> Dict:
+        return {
+            "name": self._name,
+            "description": self._description,
+            "tag_keys": self._tag_keys,
+            "default_tags": dict(self._default_tags),
+        }
+
+    def _record(self, value: float, tags: Optional[Dict[str, str]], op: str,
+                **extra):
+        from .._private import worker
+
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        client = worker.get_client()
+        client.send_async(
+            "metric_record",
+            dict(
+                extra,
+                name=self._name,
+                type=self._TYPE,
+                description=self._description,
+                value=float(value),
+                tags=tuple(sorted(merged.items())),
+                op=op,
+            ),
+        )
+
+
+class Counter(Metric):
+    """Monotonic cumulative count (reference: metrics.Counter)."""
+
+    _TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc value must be > 0")
+        self._record(value, tags, "add")
+
+
+class Gauge(Metric):
+    """Last-value-wins (reference: metrics.Gauge)."""
+
+    _TYPE = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._record(value, tags, "set")
+
+
+class Histogram(Metric):
+    """Bucketed distribution (reference: metrics.Histogram)."""
+
+    _TYPE = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Optional[Sequence[float]] = None,
+        tag_keys: Optional[Sequence[str]] = None,
+    ):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = list(boundaries or _DEFAULT_HIST_BOUNDARIES)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        self._record(value, tags, "observe", boundaries=tuple(self.boundaries))
+
+    @property
+    def info(self) -> Dict:
+        d = super().info
+        d["boundaries"] = list(self.boundaries)
+        return d
+
+
+def snapshot() -> List[Dict]:
+    """Current aggregated metrics from the hub registry."""
+    from .._private import worker
+
+    return worker.get_client().list_state("metrics")
+
+
+def prometheus_text() -> str:
+    """Render the registry in Prometheus exposition format (the
+    reference exports via its metrics agent to Prometheus; here the
+    caller mounts this on whatever HTTP surface it likes)."""
+    lines: List[str] = []
+    seen_help = set()
+    for m in snapshot():
+        name = m["name"]
+        if name not in seen_help:
+            seen_help.add(name)
+            if m.get("description"):
+                lines.append(f"# HELP {name} {m['description']}")
+            kind = {"counter": "counter", "gauge": "gauge",
+                    "histogram": "histogram"}.get(m["type"], "untyped")
+            lines.append(f"# TYPE {name} {kind}")
+        labels = ",".join(f'{k}="{v}"' for k, v in m["tags"])
+        suffix = "{" + labels + "}" if labels else ""
+        if m["type"] == "histogram":
+            acc = 0
+            for bound, count in m["buckets"]:
+                acc += count
+                lb = ",".join(filter(None, [labels, f'le="{bound}"']))
+                lines.append(f"{name}_bucket{{{lb}}} {acc}")
+            lb = ",".join(filter(None, [labels, 'le="+Inf"']))
+            lines.append(f"{name}_bucket{{{lb}}} {m['count']}")
+            lines.append(f"{name}_sum{suffix} {m['sum']}")
+            lines.append(f"{name}_count{suffix} {m['count']}")
+        else:
+            lines.append(f"{name}{suffix} {m['value']}")
+    return "\n".join(lines) + "\n"

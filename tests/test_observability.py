@@ -1,0 +1,128 @@
+"""Observability floor: metrics registry (util.metrics), task events
+feeding list_state, chrome-trace timeline, ds.stats() per-op wall
+times, and serve streaming responses."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics
+
+
+def _wait_for(cond, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_counter_gauge_histogram(ray_start_regular):
+    c = metrics.Counter("req_total", description="requests", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(5, tags={"route": "/b"})
+    g = metrics.Gauge("inflight")
+    g.set(7)
+    h = metrics.Histogram("latency_s", boundaries=[0.1, 1.0, 10.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(99.0)
+
+    def ready():
+        snap = {(m["name"], m["tags"]): m for m in metrics.snapshot()}
+        return (
+            snap.get(("req_total", (("route", "/a"),)), {}).get("value") == 3.0
+            and snap.get(("inflight", ()), {}).get("value") == 7.0
+            and snap.get(("latency_s", ()), {}).get("count") == 3
+        )
+
+    assert _wait_for(ready), metrics.snapshot()
+    snap = {(m["name"], m["tags"]): m for m in metrics.snapshot()}
+    hist = snap[("latency_s", ())]
+    assert hist["sum"] == pytest.approx(99.55)
+    assert hist["buckets"] == [[0.1, 1], [1.0, 1], [10.0, 0]]
+    text = metrics.prometheus_text()
+    assert 'req_total{route="/a"} 3.0' in text
+    assert "# TYPE latency_s histogram" in text
+
+
+def test_task_events_and_timeline(ray_start_regular):
+    @ray_tpu.remote
+    def traced():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)])
+
+    client = ray_tpu._private.worker.get_client()
+
+    def done():
+        evs = client.list_state("tasks")
+        fin = [e for e in evs if e.get("state") == "FINISHED"]
+        return len(fin) >= 3
+
+    assert _wait_for(done)
+    evs = client.list_state("tasks")
+    ev = [e for e in evs if e.get("state") == "FINISHED"][0]
+    assert ev["finished_at"] >= ev["started_at"] >= ev["submitted_at"]
+    assert ev["worker_id"] and ev["node_id"] == "node0"
+
+    trace = ray_tpu.timeline()
+    assert trace and all(t["ph"] == "X" for t in trace)
+    spans = [t for t in trace if t["dur"] >= 50_000]  # >= 50ms in usecs
+    assert spans, trace
+
+    import json
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".json", mode="r+") as f:
+        ray_tpu.timeline(filename=f.name)
+        assert json.load(f)
+
+
+def test_failed_task_event(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise RuntimeError("x")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    client = ray_tpu._private.worker.get_client()
+    assert _wait_for(
+        lambda: any(
+            e.get("state") == "FAILED" for e in client.list_state("tasks")
+        )
+    )
+
+
+def test_ds_stats(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(100).map_batches(lambda b: b).materialize()
+    s = ds.stats()
+    assert "self" in s and "blocks" in s and "total:" in s
+
+
+@pytest.fixture
+def serve_cleanup(ray_start_4_cpus):
+    from ray_tpu import serve
+
+    yield
+    serve.shutdown()
+
+
+def test_serve_streaming_response(serve_cleanup):
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Tokens:
+        def generate(self, n):
+            for i in range(n):
+                yield f"tok{i} "
+
+    h = serve.run(Tokens.bind())
+    out = list(h.options(method_name="generate", stream=True).remote(4))
+    assert out == ["tok0 ", "tok1 ", "tok2 ", "tok3 "]

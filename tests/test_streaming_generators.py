@@ -1,0 +1,129 @@
+"""Streaming generators (`num_returns="streaming"`) — reference parity:
+_raylet.pyx:280 ObjectRefGenerator. Incremental refs from task and actor
+generators, error-as-final-ref semantics, backpressure, async actors."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import ObjectRefGenerator
+from ray_tpu.exceptions import TaskError
+
+
+def test_task_generator_streams(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    g = gen.remote(5)
+    assert isinstance(g, ObjectRefGenerator)
+    vals = [ray_tpu.get(ref) for ref in g]
+    assert vals == [0, 10, 20, 30, 40]
+
+
+def test_incremental_delivery(ray_start_regular):
+    """First value is consumable before the generator finishes."""
+    import time
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        yield "first"
+        time.sleep(2.0)
+        yield "second"
+
+    g = slow_gen.remote()
+    t0 = time.time()
+    first_ref = next(g)
+    assert ray_tpu.get(first_ref) == "first"
+    assert time.time() - t0 < 1.5  # did not wait for the full generator
+    assert ray_tpu.get(next(g)) == "second"
+
+
+def test_generator_error_surfaces_as_final_ref(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad():
+        yield 1
+        raise ValueError("boom")
+
+    g = bad.remote()
+    assert ray_tpu.get(next(g)) == 1
+    with pytest.raises(TaskError):
+        ray_tpu.get(next(g))
+    with pytest.raises(StopIteration):
+        next(g)
+
+
+def test_large_values_stream_through_shm(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def chunks():
+        for i in range(3):
+            yield np.full((300_000,), float(i))
+
+    for i, ref in enumerate(chunks.remote()):
+        arr = ray_tpu.get(ref)
+        assert arr[0] == float(i) and arr.shape == (300_000,)
+
+
+def test_backpressure_bounds_producer(ray_start_regular):
+    @ray_tpu.remote(
+        num_returns="streaming", _generator_backpressure_num_objects=2
+    )
+    def gen():
+        import os, time
+
+        for i in range(6):
+            yield i
+
+    g = gen.remote()
+    # consume slowly; producer must not run unboundedly ahead (it blocks
+    # on credit after 2 unconsumed). Just verify full delivery/order.
+    out = [ray_tpu.get(r) for r in g]
+    assert out == list(range(6))
+
+
+def test_actor_sync_generator(ray_start_regular):
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield f"item{i}"
+
+    a = Gen.remote()
+    vals = [
+        ray_tpu.get(r)
+        for r in a.stream.options(num_returns="streaming").remote(3)
+    ]
+    assert vals == ["item0", "item1", "item2"]
+
+
+def test_actor_async_generator(ray_start_regular):
+    @ray_tpu.remote
+    class AGen:
+        async def stream(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i * i
+
+    a = AGen.remote()
+    vals = [
+        ray_tpu.get(r)
+        for r in a.stream.options(num_returns="streaming").remote(4)
+    ]
+    assert vals == [0, 1, 4, 9]
+
+
+def test_worker_death_ends_stream_with_error(ray_start_regular):
+    @ray_tpu.remote(num_returns="streaming")
+    def dies():
+        import os
+
+        yield 1
+        os._exit(1)
+
+    g = dies.remote()
+    assert ray_tpu.get(next(g)) == 1
+    with pytest.raises(Exception):
+        ray_tpu.get(next(g), timeout=10)
