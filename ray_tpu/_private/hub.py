@@ -281,6 +281,7 @@ class Hub:
         self._pending_fetches: Dict[int, Tuple[Any, int]] = {}
         self._spawn_wants: Dict[str, int] = {}
         self.streams: Dict[bytes, StreamEntry] = {}
+        self._ended_streams: deque = deque()  # consumed stream ids, FIFO
         # observability plane (reference: stats/metric.h registry +
         # core_worker/task_event_buffer.h -> GCS task events)
         self.metrics: Dict[Tuple[str, tuple], dict] = {}
@@ -781,6 +782,7 @@ class Hub:
     def _on_stream_end(self, conn, p):
         s = self._stream(p["task_id"])
         if p.get("error") is not None:
+            self._task_event(p["task_id"], state="FAILED")
             # the N+1-th ref carries the error (reference semantics)
             from .ids import ObjectID
 
@@ -800,8 +802,11 @@ class Hub:
         self._wake_credit_waiters(s, force=True)
 
     def _end_stream_with_error(self, task_id: bytes, err_blob) -> None:
-        s = self.streams.get(task_id)
-        if s is None or s.ended:
+        # _stream (not .get): a task failing before its first yield AND
+        # before the consumer's first next() must still leave an ended
+        # stream, or that first next() parks forever
+        s = self._stream(task_id)
+        if s.ended:
             return
         self._on_stream_end(None, {"task_id": task_id, "error": err_blob})
 
@@ -814,6 +819,15 @@ class Hub:
             self._wake_credit_waiters(s)
         elif s.ended:
             self._reply(conn, p["req_id"], end=True)
+            # consumer reached the end: drop the payload index (objects
+            # have their own lifecycle) and cap retained tombstones so
+            # the registry cannot grow without bound
+            if s.oids:
+                s.oids = []
+                self._ended_streams.append(p["task_id"])
+                while len(self._ended_streams) > 10000:
+                    old = self._ended_streams.popleft()
+                    self.streams.pop(old, None)
         else:
             s.next_waiters.setdefault(idx, []).append((conn, p["req_id"]))
 
@@ -1258,7 +1272,11 @@ class Hub:
             if actor is not None:
                 actor.inflight.pop(p["task_id"], None)
         node_id = worker.node_id if worker is not None else "node0"
-        failed = any(kind == P.VAL_ERROR for _, kind, _, _ in p["returns"])
+        prev_ev = self._task_event_index.get(p["task_id"], {})
+        failed = (
+            any(kind == P.VAL_ERROR for _, kind, _, _ in p["returns"])
+            or prev_ev.get("state") == "FAILED"
+        )
         self._task_event(
             p["task_id"], state="FAILED" if failed else "FINISHED",
             finished_at=time.time(),

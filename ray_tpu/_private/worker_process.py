@@ -105,7 +105,7 @@ class WorkerRuntime:
             blob = dumps_inline(TaskError(fn_name, tb))
         return [(oid, P.VAL_ERROR, blob, 0) for oid in return_ids]
 
-    def _stream_yield_one(self, p: dict, idx: int, value) -> None:
+    def _stream_yield_one(self, p: dict, value) -> None:
         from .ids import ObjectID
 
         oid = ObjectID.generate()
@@ -127,27 +127,12 @@ class WorkerRuntime:
         reports each return as it is produced, _raylet.pyx:280). The
         TASK_DONE at the end frees the worker; the stream itself ends via
         STREAM_END (error carried as the stream's final object)."""
-        import inspect
-
-        from .ids import ObjectID
-
         task_id = p["task_id"]
         bp = (p.get("options") or {}).get("_generator_backpressure_num_objects")
         try:
             idx = 0
             for value in gen:
-                oid = ObjectID.generate()
-                kind, payload, size = self.client.encode_value(oid, value)
-                self.client.send(
-                    P.STREAM_YIELD,
-                    {
-                        "task_id": task_id,
-                        "object_id": oid.binary(),
-                        "kind": kind,
-                        "payload": payload,
-                        "size": size,
-                    },
-                )
+                self._stream_yield_one(p, value)
                 idx += 1
                 if bp and idx >= bp:
                     # wait until the consumer is within the window
@@ -180,8 +165,22 @@ class WorkerRuntime:
                 return
             returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
         except Exception:
+            if (p.get("options") or {}).get("streaming"):
+                # failed before the generator started: the stream (not
+                # return objects) carries the error
+                self._stream_fail(p, fn_name)
+                return
             returns = self._error_returns(p["return_ids"], fn_name)
         self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
+
+    def _stream_fail(self, p: dict, name: str) -> None:
+        from ..exceptions import TaskError
+
+        err = TaskError(name, traceback.format_exc())
+        self.client.send(
+            P.STREAM_END, {"task_id": p["task_id"], "error": dumps_inline(err)}
+        )
+        self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": []})
 
     def exec_actor_create(self, p: dict):
         if p.get("tpu_chips"):
@@ -232,6 +231,9 @@ class WorkerRuntime:
                 return
             returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
         except Exception:
+            if (p.get("options") or {}).get("streaming"):
+                self._stream_fail(p, method_name)
+                return
             returns = self._error_returns(p["return_ids"], method_name)
         self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
 
@@ -265,7 +267,7 @@ class WorkerRuntime:
                         items.append(v)
                         # flush incrementally: one yield per item keeps
                         # streaming semantics without a sync bridge
-                        self._stream_yield_one(p, len(items) - 1, v)
+                        self._stream_yield_one(p, v)
                     self.client.send(
                         P.STREAM_END, {"task_id": p["task_id"], "error": None}
                     )

@@ -1,0 +1,21 @@
+"""ray_tpu.llm: LLM serving + batch inference on the in-tree Llama.
+
+Parity: python/ray/llm/ (reference delegates the engine to vLLM and the
+placement math to vllm_models.py:123-142; here both are native — the
+XLA KV-cache engine in _internal/engine.py and TP x PP placement in
+config.LLMConfig.placement_bundles)."""
+
+from ._internal.engine import GenRequest, LlamaEngine
+from .batch import build_llm_processor
+from .config import LLMConfig, save_params_npz
+from .serve import LLMServer, build_llm_app
+
+__all__ = [
+    "GenRequest",
+    "LLMConfig",
+    "LLMServer",
+    "LlamaEngine",
+    "build_llm_app",
+    "build_llm_processor",
+    "save_params_npz",
+]

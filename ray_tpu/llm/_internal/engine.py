@@ -1,0 +1,230 @@
+"""LlamaEngine: TPU-native generation with continuous batching.
+
+The role vLLM plays for the reference's ray.llm
+(reference: python/ray/llm/_internal/serve/deployments/llm/vllm/) —
+re-designed for XLA instead of wrapped:
+
+- Slot-based continuous batching: a fixed ``max_batch`` of cache slots;
+  every decode step advances ALL active slots in one jitted (B, 1)
+  program (static shapes; no recompiles as requests come and go).
+- Prefill runs per-request at power-of-two bucket lengths, writing the
+  prompt into the slot's cache rows; a handful of bucket sizes bounds
+  total compilations.
+- KV cache is preallocated (L, B, max_seq, KVH, hd); per-slot lengths
+  mask attention (models/llama.py forward_with_cache).
+- Sampling (greedy / temperature) is jitted with the decode step.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class GenRequest:
+    request_id: str
+    prompt_ids: List[int]
+    max_tokens: int = 64
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    # filled during generation
+    slot: int = -1
+    generated: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+class LlamaEngine:
+    def __init__(
+        self,
+        config,
+        params,
+        *,
+        max_batch: int = 8,
+        max_seq: int = 512,
+        seed: int = 0,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import llama
+
+        self.config = config
+        self.params = params
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.cache = llama.init_kv_cache(config, max_batch, max_seq)
+        self.lengths = np.zeros(max_batch, dtype=np.int32)  # tokens in cache
+        self.free_slots = list(range(max_batch))
+        self.active: Dict[int, GenRequest] = {}  # slot -> request
+        self._rng = jax.random.PRNGKey(seed)
+        self._jax = jax
+        self._jnp = jnp
+        self._llama = llama
+
+        # prefill buckets: powers of two up to max_seq
+        self.buckets = []
+        b = 16
+        while b < max_seq:
+            self.buckets.append(b)
+            b *= 2
+        self.buckets.append(max_seq)
+
+        @partial(jax.jit, static_argnames=("bucket",))
+        def prefill(params, cache, tokens, slot_onehot, start, length, bucket):
+            # tokens (1, bucket) padded; writes into the slot's rows and
+            # returns logits at the prompt's last real token
+            del bucket
+            logits, new_cache = llama.forward_with_cache(
+                params, tokens, cache_slice(cache, slot_onehot), start, config
+            )
+            new_cache = cache_merge(cache, new_cache, slot_onehot)
+            last = logits[0, length - 1]
+            return last, new_cache
+
+        def cache_slice(cache, slot_onehot):
+            # gather the single slot (1, S, KVH, hd) per layer
+            idx = jnp.argmax(slot_onehot)
+            return {
+                "k": jax.lax.dynamic_slice_in_dim(cache["k"], idx, 1, axis=1),
+                "v": jax.lax.dynamic_slice_in_dim(cache["v"], idx, 1, axis=1),
+            }
+
+        def cache_merge(cache, updated, slot_onehot):
+            idx = jnp.argmax(slot_onehot)
+            return {
+                "k": jax.lax.dynamic_update_slice_in_dim(
+                    cache["k"], updated["k"], idx, axis=1
+                ),
+                "v": jax.lax.dynamic_update_slice_in_dim(
+                    cache["v"], updated["v"], idx, axis=1
+                ),
+            }
+
+        @jax.jit
+        def decode(params, cache, last_tokens, lengths, temps, rng):
+            # one token for every slot: tokens (B,), lengths (B,) = count
+            # already in cache; inactive slots just waste a lane
+            logits, new_cache = llama.forward_with_cache(
+                params, last_tokens[:, None], cache, lengths, config
+            )
+            logits = logits[:, 0]  # (B, V)
+            greedy = jnp.argmax(logits, axis=-1)
+            keys = jax.random.split(rng, logits.shape[0] + 1)
+            sampled = jax.vmap(
+                lambda k, lg, t: jax.random.categorical(k, lg / jnp.maximum(t, 1e-4))
+            )(keys[1:], logits, temps)
+            toks = jnp.where(temps > 0, sampled, greedy)
+            return toks.astype(jnp.int32), new_cache, keys[0]
+
+        self._prefill = prefill
+        self._decode = decode
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def has_capacity(self) -> bool:
+        return bool(self.free_slots)
+
+    def num_active(self) -> int:
+        return len(self.active)
+
+    def add_request(self, req: GenRequest) -> bool:
+        """Admit a request into a free slot (prefill immediately)."""
+        import numpy as np
+
+        with self._lock:
+            if not self.free_slots:
+                return False
+            if len(req.prompt_ids) >= self.max_seq:
+                raise ValueError(
+                    f"prompt length {len(req.prompt_ids)} >= max_seq {self.max_seq}"
+                )
+            slot = self.free_slots.pop()
+            req.slot = slot
+            n = len(req.prompt_ids)
+            bucket = next(b for b in self.buckets if b >= n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = req.prompt_ids
+            onehot = np.zeros(self.max_batch, np.float32)
+            onehot[slot] = 1.0
+            last_logits, self.cache = self._prefill(
+                self.params, self.cache, tokens, onehot,
+                np.zeros(1, np.int32), n, bucket=bucket,
+            )
+            # first generated token comes from the prompt's last logits
+            lg = np.asarray(last_logits)
+            if req.temperature > 0:
+                self._rng, sub = self._jax.random.split(self._rng)
+                tok = int(self._jax.random.categorical(
+                    sub, self._jnp.asarray(lg) / max(req.temperature, 1e-4)))
+            else:
+                tok = int(lg.argmax())
+            req.generated.append(tok)
+            self.lengths[slot] = n
+            self.active[slot] = req
+            if req.eos_id is not None and tok == req.eos_id:
+                self._finish(slot)
+            elif len(req.generated) >= req.max_tokens:
+                self._finish(slot)
+            return True
+
+    def _finish(self, slot: int):
+        req = self.active.pop(slot)
+        req.done = True
+        self.lengths[slot] = 0
+        self.free_slots.append(slot)
+
+    def step(self) -> List[Tuple[GenRequest, int]]:
+        """One decode step for every active slot. Returns (request,
+        new_token) pairs emitted this step (callers stream them out)."""
+        import numpy as np
+
+        with self._lock:
+            if not self.active:
+                return []
+            last = np.zeros(self.max_batch, np.int32)
+            temps = np.zeros(self.max_batch, np.float32)
+            for slot, req in self.active.items():
+                last[slot] = req.generated[-1]
+                temps[slot] = req.temperature
+            toks, self.cache, self._rng = self._decode(
+                self.params, self.cache, last,
+                self.lengths, temps, self._rng,
+            )
+            toks = np.asarray(toks)
+            out = []
+            for slot in list(self.active.keys()):
+                req = self.active[slot]
+                # the decode consumed the previous token: account it
+                self.lengths[slot] += 1
+                tok = int(toks[slot])
+                req.generated.append(tok)
+                out.append((req, tok))
+                total_len = self.lengths[slot] + 1
+                if (
+                    (req.eos_id is not None and tok == req.eos_id)
+                    or len(req.generated) >= req.max_tokens
+                    or total_len >= self.max_seq - 1
+                ):
+                    self._finish(slot)
+            return out
+
+    # ------------------------------------------------------------------
+    def generate(self, prompt_ids: List[int], *, max_tokens: int = 64,
+                 temperature: float = 0.0, eos_id: Optional[int] = None
+                 ) -> List[int]:
+        """Synchronous single-prompt convenience (batch path: step())."""
+        req = GenRequest(
+            request_id="sync", prompt_ids=list(prompt_ids),
+            max_tokens=max_tokens, temperature=temperature, eos_id=eos_id,
+        )
+        ok = self.add_request(req)
+        assert ok, "engine full"
+        while not req.done:
+            self.step()
+        return req.generated

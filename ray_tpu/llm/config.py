@@ -1,0 +1,112 @@
+"""LLM deployment configuration + TP x PP placement sizing.
+
+Parity: python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_models.py:123-142 — the reference sizes a placement group from the
+engine's tensor/pipeline parallelism (PACK when pp==1, SPREAD with one
+bundle per pp rank otherwise). Here the framework owns that natively:
+``placement_bundles()`` returns the bundles + strategy the serve
+deployment (or a batch-inference actor pool) reserves.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass
+class LLMConfig:
+    """Declarative model+engine config for serving / batch inference."""
+
+    model_config: Any = None          # ray_tpu.models.llama.LlamaConfig
+    checkpoint_path: Optional[str] = None  # orbax/npz dir; None = random init
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    max_batch_size: int = 8
+    max_seq_len: int = 512
+    accelerator_type: str = "TPU"
+    # engine extras (temperature defaults etc.)
+    engine_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def placement_bundles(self) -> Tuple[List[Dict[str, float]], str]:
+        """(bundles, strategy): one bundle of tp chips per pp rank.
+
+        pp == 1  -> single PACK bundle with tp chips (one host, ICI).
+        pp  > 1  -> SPREAD, one tp-chip bundle per pipeline stage —
+        stages ride DCN between hosts, tensor parallelism stays on-host
+        ICI (the reference's PACK-vs-SPREAD split, vllm_models.py:131).
+        """
+        tp = self.tensor_parallel_size
+        pp = self.pipeline_parallel_size
+        res_key = self.accelerator_type if self.accelerator_type else "TPU"
+        if pp == 1:
+            return [{res_key: float(tp), "CPU": 1.0}], "PACK"
+        return (
+            [{res_key: float(tp), "CPU": 1.0} for _ in range(pp)],
+            "SPREAD",
+        )
+
+    def load_params(self):
+        """Materialize model params: from checkpoint_path if given
+        (orbax dir or .npz), else fresh initialization."""
+        import jax
+
+        from ray_tpu.models import llama
+
+        cfg = self.model_config or llama.LLAMA_TINY
+        if not self.checkpoint_path:
+            return llama.init_params(jax.random.PRNGKey(0), cfg)
+        import os
+
+        if self.checkpoint_path.endswith(".npz"):
+            import numpy as np
+
+            flat = dict(np.load(self.checkpoint_path))
+            return _unflatten(flat)
+        # orbax checkpoint dir (the Train stack's format,
+        # train/_checkpoint.py)
+        import orbax.checkpoint as ocp
+
+        target = llama.init_params(jax.random.PRNGKey(0), cfg)
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(os.path.abspath(self.checkpoint_path), target)
+
+
+def save_params_npz(params, path: str) -> None:
+    """Flat .npz export (portable mini-format for tests/examples)."""
+    import numpy as np
+
+    flat = _flatten(params)
+    np.savez(path, **{k: np.asarray(v) for k, v in flat.items()})
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def _unflatten(flat):
+    root: dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return _listify(root)
+
+
+def _listify(node):
+    if not isinstance(node, dict):
+        return node
+    if node and all(k.isdigit() for k in node):
+        return [_listify(node[k]) for k in sorted(node, key=int)]
+    return {k: _listify(v) for k, v in node.items()}

@@ -319,6 +319,99 @@ def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
     return jnp.mean(nll)
 
 
+# ---------------------------------------------------------------------
+# KV-cache inference path (used by ray_tpu.llm — reference analogue:
+# python/ray/llm delegates generation to vLLM; here generation is
+# in-tree and XLA-shaped: static cache shapes, dynamic_update_slice
+# writes, length-masked attention, one jitted program per bucket).
+# ---------------------------------------------------------------------
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_seq: int):
+    """Preallocated cache: k/v (L, B, max_seq, KVH, hd) in config.dtype."""
+    c = config
+    shape = (c.n_layers, batch, max_seq, c.n_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+    }
+
+
+def _attention_cached(q, k_cache, v_cache, pos, config: LlamaConfig):
+    """q (B, T, H, hd) new queries at absolute positions ``pos`` (B, T);
+    k/v_cache (B, S, KVH, hd) hold all tokens written so far (including
+    the new ones). Rows attend to cache slots <= their position."""
+    B, T, H, hd = q.shape
+    S = k_cache.shape[1]
+    KVH = k_cache.shape[2]
+    G = H // KVH
+    qg = q.reshape(B, T, KVH, G, hd)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum(
+        "btkgh,bskh->bkgts", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    mask = jnp.arange(S)[None, None, :] <= pos[:, :, None]  # (B, T, S)
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v_cache.dtype)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs, v_cache)
+    return out.reshape(B, T, H, hd)
+
+
+def forward_with_cache(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cache: Dict[str, jax.Array],
+    start_pos: jax.Array,
+    config: LlamaConfig,
+):
+    """Incremental forward: tokens (B, T) appended at per-sequence
+    offsets ``start_pos`` (B,). Returns (logits (B, T, V) fp32, updated
+    cache). T is static (bucketed by the engine); start_pos is traced.
+    """
+    c = config
+    B, T = tokens.shape
+    max_seq = cache["k"].shape[2]
+    x = params["embed"].astype(c.dtype)[tokens]
+    cos_full, sin_full = rope_table(c, max_seq)
+    pos = start_pos[:, None] + jnp.arange(T)[None, :]          # (B, T)
+    cos = cos_full[pos]                                         # (B, T, hd/2)
+    sin = sin_full[pos]
+
+    def body(x, layer_and_cache):
+        layer, k_c, v_c = layer_and_cache
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(c.dtype))
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(c.dtype))
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(c.dtype))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        # scatter the T new k/v rows into each sequence's slot range
+        def write(cache_b, new_b, start_b):
+            return jax.lax.dynamic_update_slice(
+                cache_b, new_b.astype(cache_b.dtype), (start_b, 0, 0)
+            )
+
+        k_c = jax.vmap(write)(k_c, k, start_pos)
+        v_c = jax.vmap(write)(v_c, v, start_pos)
+        attn = _attention_cached(q, k_c, v_c, pos, c)
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(c.dtype))
+        h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(c.dtype))
+        x = x + jnp.einsum(
+            "bsf,fd->bsd", jax.nn.silu(gate) * up, layer["w_down"].astype(c.dtype)
+        )
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(c.dtype))
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
 def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
     """Approx training FLOPs/token: 6*N matmul + attention term."""
     n = param_count(config) - config.vocab_size * config.dim  # non-embed approx

@@ -94,6 +94,13 @@ class Replica:
             result = target(*args, **kwargs)
             if inspect.isgenerator(result):
                 yield from result
+            elif inspect.isasyncgen(result):
+                # drain the async generator on the replica's loop
+                while True:
+                    try:
+                        yield _run_coro(result.__anext__())
+                    except StopAsyncIteration:
+                        break
             else:
                 if inspect.iscoroutine(result):
                     result = _run_coro(result)

@@ -203,16 +203,18 @@ class DeploymentHandle:
         self._reconcile_inflight()
         replica = self._pick(replicas)
         rid = _rid(replica)
-        with self._lock:
-            self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
         if self._stream:
             # streamed responses flow as an ObjectRefGenerator; no
             # transparent replica retry (a half-consumed stream is not
-            # transparently re-executable)
+            # transparently re-executable), and no _outstanding
+            # accounting — there is no single completion ref to credit
+            # the count back against
             ref_gen = replica.handle_request_streaming.options(
                 num_returns="streaming"
             ).remote(method, args, kwargs)
             return DeploymentResponseGenerator(ref_gen)
+        with self._lock:
+            self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
         ref = replica.handle_request.remote(method, args, kwargs)
         with self._lock:
             self._inflight[ref] = rid

@@ -115,6 +115,22 @@ def test_actor_async_generator(ray_start_regular):
     assert vals == [0, 1, 4, 9]
 
 
+def test_failure_before_first_yield_ends_stream(ray_start_regular):
+    """Arg-binding/decode errors happen before the generator exists; the
+    stream must still end with the error (review finding: consumer hung
+    forever otherwise)."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(a, b):
+        yield a + b
+
+    g = gen.remote(1)  # TypeError: missing positional arg
+    with pytest.raises(TaskError):
+        ray_tpu.get(next(g), timeout=15)
+    with pytest.raises(StopIteration):
+        next(g)
+
+
 def test_worker_death_ends_stream_with_error(ray_start_regular):
     @ray_tpu.remote(num_returns="streaming")
     def dies():
