@@ -1,43 +1,118 @@
 """Compiled DAG execution.
 
-Parity: python/ray/dag/compiled_dag_node.py:805 (CompiledDAG) — the
-reference compiles an actor-task DAG into a static pipeline: per-actor
-resident exec loops plus pre-allocated channels, so each execute() is
-channel writes, not task submissions. On this runtime the compile step:
+Parity: python/ray/dag/compiled_dag_node.py:805 (CompiledDAG). Two
+modes:
 
-1. freezes the topological schedule (no per-execute graph traversal),
-2. pre-resolves each node's (callable, upstream-slot) plan,
-3. submits the WHOLE graph's tasks back-to-back per execute, with
-   upstream ObjectRefs passed directly (data flows worker→worker
-   through the shared-memory object plane; the driver never touches
-   payloads), and
-4. supports overlapped executions in flight (the pipelining
-   compiled graphs exist for) bounded by ``max_inflight_executions``.
+**Channel mode** (the reference's true compiled path): when every
+compute node is an actor-method node annotated with a fixed-shape
+channel (``node.with_shm_channel(shape, dtype)``), compilation
 
-The TPU mapping of the reference's NCCL channels — mutable HBM
-buffers between jitted stages — lives in
-ray_tpu.experimental.channel (host shm ring channels today; the ICI
-path is jit-level, see ray_tpu.parallel.pipeline which moves
-stage→stage activations with `lax.ppermute` inside ONE program).
+1. allocates one shared-memory ring channel per DAG edge
+   (experimental/channel/shm_channel.py — the analogue of the
+   reference's mutable-plasma channels,
+   shared_memory_channel.py:151), and
+2. parks a resident exec loop on each actor via ``__ray_call__``
+   (the reference's ``do_exec_tasks`` :193).
+
+``execute()`` is then pure channel I/O — the driver writes the input
+segment and later reads the output segment; the scheduler sees ZERO
+task submissions per execution (asserted in tests via the hub's task
+counters). In-flight executions pipeline up to the ring capacity.
+
+**Legacy mode** (fallback for un-annotated graphs): the frozen topo
+schedule re-submits tasks per execute with refs flowing
+worker-to-worker — still no per-execute graph traversal, but each node
+costs a scheduler round trip.
+
+The TPU mapping of the reference's NCCL channels — HBM buffers between
+jitted stages over ICI — is jit-level: ray_tpu.parallel.pipeline moves
+stage activations with `lax.ppermute` inside ONE program.
 """
 
 from __future__ import annotations
 
+import itertools
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-from .dag_node import DAGNode, InputAttributeNode, InputNode, MultiOutputNode
+from .dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+def _compiled_exec_loop(instance, method_name, arg_plan, out_descs, stop_desc):
+    """Resident per-actor loop (reference: do_exec_tasks,
+    compiled_dag_node.py:193). Runs inside the actor via __ray_call__:
+    read inputs from ring channels, run the method, write outputs —
+    until the stop channel signals teardown."""
+    import numpy as np
+
+    from ray_tpu.experimental.channel.shm_channel import ShmChannel
+
+    chans = {}
+
+    def attach(desc):
+        if desc not in chans:
+            chans[desc] = ShmChannel(*desc)
+        return chans[desc]
+
+    ins = [
+        ("chan", attach(kind_val)) if kind == "chan" else ("lit", kind_val)
+        for kind, kind_val in arg_plan
+    ]
+    outs = [attach(d) for d in out_descs]
+    stop = attach(stop_desc)
+    method = getattr(instance, method_name)
+    try:
+        while True:
+            args = []
+            stopped = False
+            for kind, src in ins:
+                if kind == "lit":
+                    args.append(src)
+                    continue
+                while True:
+                    if stop.try_read() is not None:
+                        stopped = True
+                        break
+                    try:
+                        args.append(src.read(timeout_s=0.2))
+                        break
+                    except TimeoutError:
+                        continue
+                if stopped:
+                    break
+            if stopped:
+                return "stopped"
+            if not ins and stop.try_read() is not None:
+                return "stopped"
+            out = method(*args)
+            for ch in outs:
+                ch.write(np.asarray(out))
+    finally:
+        for ch in chans.values():
+            ch.close()
 
 
 class CompiledDAGRef:
     """Future for one compiled execution (reference:
-    experimental/compiled_dag_ref.py)."""
+    experimental/compiled_dag_ref.py). Channel mode delivers results in
+    execution order — get() must follow that order."""
 
-    def __init__(self, dag: "CompiledDAG", value):
+    def __init__(self, dag: "CompiledDAG", value=None, seq: Optional[int] = None):
         self._dag = dag
         self._value = value
+        self._seq = seq
+        self._result = None
+        self._got = False
 
     def get(self, timeout: Optional[float] = None):
+        if self._seq is not None:  # channel mode
+            return self._dag._channel_get(self, timeout)
         import ray_tpu
 
         self._dag._retire(self)
@@ -57,21 +132,170 @@ class CompiledDAG:
         self._schedule = root._topo()  # frozen order
         self._max_inflight = max_inflight_executions
         self._inflight: deque = deque()
-        # sanity: compiled graphs take exactly one InputNode
         self._inputs = [n for n in self._schedule if type(n) is InputNode]
         if len(self._inputs) > 1:
             raise ValueError("compiled DAG must have exactly one InputNode")
+        self._channel_mode = False
+        self._torn_down = False
+        if self._qualifies_for_channels():
+            self._compile_channels()
 
-    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+    # ------------------------------------------------------- channel mode
+    def _qualifies_for_channels(self) -> bool:
+        for node in self._schedule:
+            if type(node) in (InputNode, MultiOutputNode):
+                continue
+            if isinstance(node, ClassMethodNode) and getattr(
+                node, "_channel_spec", None
+            ):
+                continue
+            return False
+        leaves = (
+            list(self._root._bound_args)
+            if isinstance(self._root, MultiOutputNode)
+            else [self._root]
+        )
+        return bool(self._inputs) and all(
+            isinstance(x, ClassMethodNode) for x in leaves
+        )
+
+    def _compile_channels(self) -> None:
+        import ray_tpu
+        from ray_tpu.experimental.channel.shm_channel import ShmChannel
+
+        cap = self._max_inflight
+        # one SPSC channel per edge (producer node -> consumer node);
+        # the driver is producer for input edges and consumer of leaves
+        self._edge_chans: Dict[Tuple[int, int], ShmChannel] = {}
+
+        def edge(producer: DAGNode, consumer_id: int, spec) -> ShmChannel:
+            key = (producer._id, consumer_id)
+            if key not in self._edge_chans:
+                self._edge_chans[key] = ShmChannel.create(
+                    shape=spec[0], dtype=spec[1], capacity=cap
+                )
+            return self._edge_chans[key]
+
+        def desc(ch: ShmChannel):
+            return (ch.name, ch.shape, str(ch.dtype), ch.capacity)
+
+        self._stop_chans: List[ShmChannel] = []
+        self._loop_refs = []
+        actors_seen = set()
+        compute_nodes = [
+            n for n in self._schedule if isinstance(n, ClassMethodNode)
+        ]
+        for node in compute_nodes:
+            actor = node._method._handle
+            aid = actor._actor_id.binary()
+            if aid in actors_seen:
+                raise ValueError(
+                    "channel-compiled DAGs support one node per actor "
+                    "(the resident exec loop pins the actor)"
+                )
+            actors_seen.add(aid)
+            arg_plan = []
+            for arg in node._bound_args:
+                if isinstance(arg, InputNode):
+                    spec = getattr(arg, "_channel_spec", None) or node._channel_spec
+                    arg_plan.append(("chan", desc(edge(arg, node._id, spec))))
+                elif isinstance(arg, ClassMethodNode):
+                    arg_plan.append(
+                        ("chan", desc(edge(arg, node._id, arg._channel_spec)))
+                    )
+                elif isinstance(arg, DAGNode):
+                    raise ValueError(
+                        f"unsupported upstream node {type(arg).__name__} in "
+                        "channel-compiled DAG"
+                    )
+                else:
+                    arg_plan.append(("lit", arg))
+            # output edges materialize when consumers register; collect
+            # them after the full pass
+            node._arg_plan = arg_plan
+        # second pass: each node's out-edges (to consumers or the driver)
+        self._out_chans: List[ShmChannel] = []
+        leaves = (
+            list(self._root._bound_args)
+            if isinstance(self._root, MultiOutputNode)
+            else [self._root]
+        )
+        for node in compute_nodes:
+            out_descs = []
+            for key, ch in self._edge_chans.items():
+                if key[0] == node._id:
+                    out_descs.append(desc(ch))
+            if node in leaves:
+                ch = edge(node, -1, node._channel_spec)  # -1 = driver
+                out_descs.append(desc(ch))
+            stop = ShmChannel.create(shape=(1,), dtype="int8", capacity=4)
+            self._stop_chans.append(stop)
+            self._loop_refs.append(
+                node._method._handle.__ray_call__.remote(
+                    _compiled_exec_loop,
+                    node._method._name,
+                    node._arg_plan,
+                    out_descs,
+                    desc(stop),
+                )
+            )
+        self._driver_out = [self._edge_chans[(leaf._id, -1)] for leaf in leaves]
+        self._multi_output = isinstance(self._root, MultiOutputNode)
+        self._input_edges = [
+            ch for (pid, _), ch in self._edge_chans.items()
+            if pid == self._inputs[0]._id
+        ] if self._inputs else []
+        self._seq_submit = itertools.count()
+        self._seq_read = 0
+        self._channel_mode = True
+
+    def _channel_execute(self, args) -> CompiledDAGRef:
+        import numpy as np
+
+        if self._torn_down:
+            raise RuntimeError("compiled DAG was torn down")
+        if len(args) != 1:
+            raise ValueError("channel-compiled DAGs take exactly one input array")
         while len(self._inflight) >= self._max_inflight:
-            # backpressure: wait for the oldest execution to COMPLETE —
-            # no result fetch; payloads stay in the object plane
+            # backpressure: block until the oldest result is consumed
+            oldest = self._inflight[0]
+            self._channel_get(oldest, timeout=60.0)
+        arr = np.asarray(args[0])
+        for ch in self._input_edges:
+            ch.write(arr)
+        ref = CompiledDAGRef(self, seq=next(self._seq_submit))
+        self._inflight.append(ref)
+        return ref
+
+    def _channel_get(self, ref: CompiledDAGRef, timeout: Optional[float]):
+        if ref._got:
+            return ref._result
+        if ref._seq != self._seq_read:
+            raise RuntimeError(
+                "channel-mode results must be consumed in execution order "
+                f"(next is seq {self._seq_read}, asked for {ref._seq})"
+            )
+        out = [ch.read(timeout_s=timeout or 60.0) for ch in self._driver_out]
+        ref._result = out if self._multi_output else out[0]
+        ref._got = True
+        self._seq_read += 1
+        try:
+            self._inflight.remove(ref)
+        except ValueError:
+            pass
+        return ref._result
+
+    # ---------------------------------------------------------- execution
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._channel_mode:
+            return self._channel_execute(args)
+        while len(self._inflight) >= self._max_inflight:
             oldest = self._inflight.popleft()
             oldest._wait_done()
         results: Dict[int, Any] = {}
         for node in self._schedule:
             results[node._id] = node._apply(results, args, kwargs)
-        ref = CompiledDAGRef(self, results[self._root._id])
+        ref = CompiledDAGRef(self, value=results[self._root._id])
         self._inflight.append(ref)
         return ref
 
@@ -83,3 +307,28 @@ class CompiledDAG:
 
     def teardown(self) -> None:
         self._inflight.clear()
+        if self._channel_mode and not self._torn_down:
+            self._torn_down = True
+            import numpy as np
+
+            import ray_tpu
+
+            for stop in self._stop_chans:
+                try:
+                    stop.write(np.zeros(1, dtype=np.int8))
+                except TimeoutError:
+                    pass
+            try:
+                ray_tpu.get(self._loop_refs, timeout=10)
+            except Exception:
+                pass
+            for ch in self._edge_chans.values():
+                ch.close(unlink=True)
+            for stop in self._stop_chans:
+                stop.close(unlink=True)
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

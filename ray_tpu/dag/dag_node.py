@@ -83,6 +83,14 @@ class DAGNode:
     def _apply(self, results, input_args, input_kwargs):
         raise NotImplementedError
 
+    def with_shm_channel(self, shape, dtype: str = "float32") -> "DAGNode":
+        """Declare this node's output as a fixed-shape numpy payload so
+        experimental_compile() can pre-allocate a shared-memory ring
+        channel for it (reference: with_type_hint/TorchTensorType on DAG
+        nodes feeding the channel allocator)."""
+        self._channel_spec = (tuple(shape), dtype)
+        return self
+
     def experimental_compile(self, **kwargs):
         from .compiled_dag import CompiledDAG
 

@@ -1,0 +1,99 @@
+"""True compiled graphs: resident actor exec loops over shm ring
+channels — execute() must cost ZERO scheduler round trips (reference:
+compiled_dag_node.py:193 do_exec_tasks + pre-allocated channels)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@ray_tpu.remote
+class Doubler:
+    def run(self, x):
+        return x * 2.0
+
+
+@ray_tpu.remote
+class AddOne:
+    def run(self, x):
+        return x + 1.0
+
+
+def _num_task_events():
+    return len(ray_tpu._private.worker.get_client().list_state("tasks"))
+
+
+def test_channel_pipeline_zero_scheduler_roundtrips(ray_start_4_cpus):
+    a, b = Doubler.remote(), AddOne.remote()
+    with InputNode() as inp:
+        dag = b.run.bind(a.run.bind(inp).with_shm_channel((4,))).with_shm_channel((4,))
+    compiled = dag.experimental_compile(max_inflight_executions=4)
+    assert compiled._channel_mode
+    # warm: first execute after loops spin up
+    out = compiled.execute(np.ones(4, np.float32)).get(timeout=30)
+    np.testing.assert_allclose(out, np.full(4, 3.0))
+
+    before = _num_task_events()
+    refs = [
+        compiled.execute(np.full(4, float(i), np.float32)) for i in range(8)
+    ]
+    outs = [r.get(timeout=30) for r in refs]
+    after = _num_task_events()
+    assert after == before, "execute() must not submit scheduler tasks"
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o, np.full(4, 2.0 * i + 1.0))
+    compiled.teardown()
+
+
+def test_channel_multi_output(ray_start_4_cpus):
+    a, b = Doubler.remote(), AddOne.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode(
+            [
+                a.run.bind(inp).with_shm_channel((2,)),
+                b.run.bind(inp).with_shm_channel((2,)),
+            ]
+        )
+    compiled = dag.experimental_compile()
+    assert compiled._channel_mode
+    out = compiled.execute(np.array([1.0, 2.0], np.float32)).get(timeout=30)
+    np.testing.assert_allclose(out[0], [2.0, 4.0])
+    np.testing.assert_allclose(out[1], [2.0, 3.0])
+    compiled.teardown()
+
+
+def test_unannotated_graph_falls_back_to_legacy(ray_start_4_cpus):
+    a = Doubler.remote()
+    with InputNode() as inp:
+        dag = a.run.bind(inp)  # no channel hint
+    compiled = dag.experimental_compile()
+    assert not compiled._channel_mode
+    assert compiled.execute(np.ones(2)).get(timeout=30)[0] == 2.0
+
+
+def test_actor_usable_after_teardown(ray_start_4_cpus):
+    a = Doubler.remote()
+    with InputNode() as inp:
+        dag = a.run.bind(inp).with_shm_channel((2,))
+    compiled = dag.experimental_compile()
+    out = compiled.execute(np.ones(2, np.float32)).get(timeout=30)
+    np.testing.assert_allclose(out, [2.0, 2.0])
+    compiled.teardown()
+    # the resident loop released the actor: plain calls work again
+    assert ray_tpu.get(a.run.remote(np.ones(2)), timeout=30)[0] == 2.0
+
+
+def test_out_of_order_get_rejected(ray_start_4_cpus):
+    a = Doubler.remote()
+    with InputNode() as inp:
+        dag = a.run.bind(inp).with_shm_channel((2,))
+    compiled = dag.experimental_compile()
+    r1 = compiled.execute(np.ones(2, np.float32))
+    r2 = compiled.execute(np.ones(2, np.float32))
+    with pytest.raises(RuntimeError):
+        r2.get(timeout=10)
+    r1.get(timeout=10)
+    r2.get(timeout=10)
+    compiled.teardown()

@@ -1,0 +1,93 @@
+"""1F1B pipeline training: gradient correctness vs a single-device
+reference, equivalence with GPipe, and the 1F1B memory win (smaller
+activation stash => smaller compiled temp memory)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.parallel.pipeline import pipeline_train
+
+P_STAGES = 4
+FDIM = 16
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _loss_fn(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    devs = jax.devices()[:P_STAGES]
+    mesh = Mesh(np.asarray(devs), ("pipe",))
+    k = jax.random.PRNGKey(0)
+    stacked = {
+        "w": jax.random.normal(k, (P_STAGES, FDIM, FDIM)) * 0.3,
+        "b": jnp.zeros((P_STAGES, FDIM)),
+    }
+    batch = jax.random.normal(jax.random.PRNGKey(1), (32, FDIM))
+    targets = jax.random.normal(jax.random.PRNGKey(2), (32, FDIM))
+    return mesh, stacked, batch, targets
+
+
+def _reference(stacked, batch, targets, microbatch=4):
+    """Single-device truth: same microbatched loss/grad computation."""
+
+    def full_loss(params):
+        M = batch.shape[0] // microbatch
+        total = 0.0
+        for m in range(M):
+            x = batch[m * microbatch:(m + 1) * microbatch]
+            t = targets[m * microbatch:(m + 1) * microbatch]
+            for p in range(P_STAGES):
+                x = _stage_fn(jax.tree.map(lambda v: v[p], params), x)
+            total = total + _loss_fn(x, t)
+        return total / M
+
+    loss, grads = jax.value_and_grad(full_loss)(stacked)
+    return loss, grads
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_grads_match_single_device(setup, schedule):
+    mesh, stacked, batch, targets = setup
+    run = pipeline_train(
+        _stage_fn, stacked, mesh, loss_fn=_loss_fn,
+        microbatch_size=4, schedule=schedule,
+    )
+    loss, grads = jax.jit(run)(batch, targets)
+    ref_loss, ref_grads = _reference(stacked, batch, targets)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for key in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(grads[key]), np.asarray(ref_grads[key]),
+            atol=1e-5, rtol=1e-5, err_msg=f"{schedule}:{key}",
+        )
+
+
+def test_1f1b_uses_less_memory_than_gpipe(setup):
+    """The point of 1F1B: stash bounded by 2P-1 instead of M. Assert via
+    XLA's compiled memory analysis (temp allocation covers the stash)."""
+    mesh, stacked, _, _ = setup
+    big_batch = jax.random.normal(jax.random.PRNGKey(3), (128, FDIM))
+    big_targets = jax.random.normal(jax.random.PRNGKey(4), (128, FDIM))
+
+    sizes = {}
+    for schedule in ("1f1b", "gpipe"):
+        run = pipeline_train(
+            _stage_fn, stacked, mesh, loss_fn=_loss_fn,
+            microbatch_size=4, schedule=schedule,  # M=32 microbatches
+        )
+        compiled = jax.jit(run).lower(big_batch, big_targets).compile()
+        sizes[schedule] = compiled.memory_analysis().temp_size_in_bytes
+
+    assert sizes["1f1b"] < sizes["gpipe"], sizes
+    # loose sanity on the ratio: stash 2P-1=7 vs M=32 slots
+    assert sizes["1f1b"] < 0.7 * sizes["gpipe"], sizes
