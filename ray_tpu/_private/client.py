@@ -56,6 +56,7 @@ class CoreClient:
         self._obj_cache_lock = threading.Lock()
         self._seen_fns: Dict[str, Any] = {}
         self.task_queue: "queue.Queue" = queue.Queue()
+        self.cancelled_tasks: set = set()  # task_ids to drop at dequeue
         self._closed = False
         self.send(P.HELLO, {"role": role, "worker_id": worker_id,
                             "pid": os.getpid(), "node_id": self.node_id})
@@ -120,6 +121,28 @@ class CoreClient:
                         fut = self._pending.pop(req_id, None)
                     if fut is not None:
                         fut.set_result(payload)
+                elif msg_type == P.CANCEL_TASK:
+                    # reader-thread fast path: mark before the executor
+                    # dequeues it AND resolve the caller immediately —
+                    # the executor may be busy for a long time before it
+                    # ever sees the queued message (it drops it silently
+                    # at dequeue; a late duplicate TASK_DONE is ignored
+                    # because error objects are first-write-wins)
+                    self.cancelled_tasks.add(payload["task_id"])
+                    if payload.get("return_ids"):
+                        blob = dumps_inline(
+                            exceptions.TaskCancelledError("task was cancelled")
+                        )
+                        self.send(
+                            P.TASK_DONE,
+                            {
+                                "task_id": payload["task_id"],
+                                "returns": [
+                                    (oid, P.VAL_ERROR, blob, 0)
+                                    for oid in payload["return_ids"]
+                                ],
+                            },
+                        )
                 else:
                     # Task assignment (worker role) or control message.
                     self.task_queue.put((msg_type, payload))
@@ -132,14 +155,47 @@ class CoreClient:
                     fut.set_exception(ConnectionError("hub connection lost"))
             self.task_queue.put((P.KILL, {}))
 
+    # Request types safe to retransmit when a reply is slow/lost: reads
+    # and idempotent writes. Lost-message tolerance is what the chaos
+    # tests (RAY_TPU_CHAOS_DROP) exercise — the reference gets the same
+    # property from its retryable gRPC client (rpc/retryable_grpc_client.h).
+    _RETRY_SAFE = {
+        P.GET, P.WAIT, P.KV_GET, P.KV_PUT, P.KV_KEYS, P.KV_DEL,
+        P.GET_ACTOR, P.GET_FUNCTION, P.LIST_STATE, P.CLUSTER_RESOURCES,
+        P.PG_READY, P.STREAM_NEXT, P.STREAM_CREDIT, P.FETCH_OBJECT,
+    }
+    _RETRY_PERIOD_S = 2.0
+
     def request(self, msg_type: str, payload: dict, timeout: Optional[float] = None) -> dict:
+        import time as _time
+        from concurrent.futures import TimeoutError as _FutTimeout
+
         req_id = next(self._req_counter)
         fut: Future = Future()
         with self._pending_lock:
             self._pending[req_id] = fut
         payload = dict(payload, req_id=req_id)
         self.send(msg_type, payload)
-        return fut.result(timeout=timeout)
+        retryable = msg_type in self._RETRY_SAFE and not (
+            msg_type == P.KV_PUT and not payload.get("overwrite", True)
+        )
+        if not retryable:
+            return fut.result(timeout=timeout)
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            remaining = self._RETRY_PERIOD_S
+            if deadline is not None:
+                remaining = min(remaining, deadline - _time.monotonic())
+                if remaining <= 0:
+                    raise TimeoutError(f"{msg_type} request timed out")
+            try:
+                return fut.result(timeout=remaining)
+            except _FutTimeout:
+                if self._closed:
+                    raise ConnectionError("hub connection lost") from None
+                # reply lost or hub slow: retransmit the same req_id (a
+                # duplicate reply finds no pending future and is dropped)
+                self.send(msg_type, payload)
 
     # --------------------------------------------------------------- objects
     def put_value(self, obj: Any, object_id: Optional[ObjectID] = None) -> ObjectID:

@@ -224,6 +224,9 @@ class Hub:
             _tempfile.gettempdir(), "ray_tpu_spill_" + os.path.basename(session_dir)
         )
 
+        # chaos config is re-read per hub so tests can set the env after
+        # the module was first imported (reference: rpc_chaos.h Init)
+        self._chaos = _parse_chaos()
         self.session_dir = session_dir
         os.makedirs(session_dir, exist_ok=True)
         if tcp:
@@ -369,10 +372,10 @@ class Hub:
 
     # -------------------------------------------------------------- dispatch
     def _handle(self, conn, msg_type: str, payload):
-        if _CHAOS:
+        if self._chaos:
             import random
 
-            prob = _CHAOS.get(msg_type)
+            prob = self._chaos.get(msg_type)
             if prob and random.random() < prob:
                 return  # injected message drop
         if msg_type == "batch":
@@ -1563,7 +1566,11 @@ class Hub:
             self._release_task_resources(spec)
         if spec is not None and not spec.is_actor_create:
             self._release_task_resources(spec)
-            if spec.retries_left > 0:
+            if spec.options.get("_cancelled"):
+                from ..exceptions import TaskCancelledError
+
+                self._fail_task(spec, TaskCancelledError("task was cancelled"))
+            elif spec.retries_left > 0:
                 spec.retries_left -= 1
                 self._enqueue_runnable(spec)
             else:
@@ -1618,8 +1625,12 @@ class Hub:
         self._dispatch()
 
     def _on_cancel(self, conn, p):
-        # best-effort: remove from runnable / pending
+        """Cancel a task by one of its return objects. Queued tasks are
+        dequeued and failed; RUNNING tasks are interrupted — SIGINT for
+        the cooperative path, worker kill for force=True (reference:
+        ray.cancel force semantics, core_worker CancelTask)."""
         oid = p["object_id"]
+        force = p.get("force", False)
         from ..exceptions import TaskCancelledError
 
         for q in self.runnable.values():
@@ -1629,6 +1640,44 @@ class Hub:
                     self.tasks.pop(spec.task_id, None)
                     self._fail_task(spec, TaskCancelledError("task was cancelled"))
                     return
+        # queued actor calls
+        for actor in self.actors.values():
+            for spec in list(actor.pending_calls):
+                if oid in spec.return_ids:
+                    actor.pending_calls.remove(spec)
+                    self._fail_task(spec, TaskCancelledError("task was cancelled"))
+                    return
+        # actor calls already forwarded to the worker: mark them
+        # cancelled worker-side (the worker drops them at dequeue; the
+        # one currently executing cannot be cooperatively stopped)
+        for actor in self.actors.values():
+            for spec in actor.inflight.values():
+                if oid in spec.return_ids:
+                    worker = self.workers.get(actor.worker_id)
+                    if worker is not None and worker.conn is not None:
+                        self._send(worker.conn, P.CANCEL_TASK,
+                                   {"task_id": spec.task_id,
+                                    "return_ids": spec.return_ids})
+                    return
+        # running task: interrupt its worker
+        for w in self.workers.values():
+            spec = w.current_task
+            if spec is not None and oid in spec.return_ids:
+                spec.options["_cancelled"] = True
+                spec.retries_left = 0
+                if force:
+                    self._kill_worker(w)
+                elif w.proc is not None:
+                    import signal
+
+                    try:
+                        w.proc.send_signal(signal.SIGINT)
+                    except Exception:
+                        pass
+                # running on a remote node without force: best-effort
+                # no-op (the reference likewise cannot interrupt
+                # arbitrary native code without force)
+                return
 
     # ----- placement groups
     def _on_create_pg(self, conn, p):

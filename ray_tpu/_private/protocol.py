@@ -63,6 +63,7 @@ EXEC_TASK = "exec_task"
 EXEC_ACTOR_CREATE = "exec_actor_create"
 EXEC_ACTOR_TASK = "exec_actor_task"
 KILL = "kill"
+CANCEL_TASK = "cancel_task"  # hub -> worker: drop a queued task
 
 # hub -> client
 REPLY = "reply"

@@ -94,11 +94,15 @@ class WorkerRuntime:
         return out
 
     def _error_returns(self, return_ids, fn_name: str):
-        from ..exceptions import TaskError
+        from ..exceptions import TaskCancelledError, TaskError
 
         tb = traceback.format_exc()
         exc_type, exc, _ = sys.exc_info()
-        err = TaskError(fn_name, tb, cause=None)
+        if exc_type is KeyboardInterrupt:
+            # hub-sent SIGINT = cooperative cancellation (ray.cancel)
+            err: Exception = TaskCancelledError("task was cancelled")
+        else:
+            err = TaskError(fn_name, tb, cause=None)
         try:
             blob = dumps_inline(err)
         except Exception:
@@ -164,7 +168,7 @@ class WorkerRuntime:
                 self._stream_results(p, result)
                 return
             returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
-        except Exception:
+        except (Exception, KeyboardInterrupt):
             if (p.get("options") or {}).get("streaming"):
                 # failed before the generator started: the stream (not
                 # return objects) carries the error
@@ -172,6 +176,11 @@ class WorkerRuntime:
                 return
             returns = self._error_returns(p["return_ids"], fn_name)
         self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
+
+    def reply_cancelled(self, p: dict) -> None:
+        # the reader thread already resolved the caller (CANCEL_TASK
+        # fast path); dequeue just discards the stale assignment
+        self.client.cancelled_tasks.discard(p["task_id"])
 
     def _stream_fail(self, p: dict, name: str) -> None:
         from ..exceptions import TaskError
@@ -317,15 +326,23 @@ def main():
 
     rt = WorkerRuntime(client)
     while True:
-        msg_type, payload = client.task_queue.get()
-        if msg_type == P.KILL:
-            os._exit(0)
-        elif msg_type == P.EXEC_TASK:
-            rt.exec_task(payload)
-        elif msg_type == P.EXEC_ACTOR_CREATE:
-            rt.exec_actor_create(payload)
-        elif msg_type == P.EXEC_ACTOR_TASK:
-            rt.exec_actor_task(payload)
+        try:
+            msg_type, payload = client.task_queue.get()
+            if msg_type == P.KILL:
+                os._exit(0)
+            elif msg_type in (P.EXEC_TASK, P.EXEC_ACTOR_TASK) and (
+                payload["task_id"] in client.cancelled_tasks
+            ):
+                rt.reply_cancelled(payload)
+            elif msg_type == P.EXEC_TASK:
+                rt.exec_task(payload)
+            elif msg_type == P.EXEC_ACTOR_CREATE:
+                rt.exec_actor_create(payload)
+            elif msg_type == P.EXEC_ACTOR_TASK:
+                rt.exec_actor_task(payload)
+        except KeyboardInterrupt:
+            # cancellation SIGINT landed between tasks: stay alive
+            continue
 
 
 if __name__ == "__main__":

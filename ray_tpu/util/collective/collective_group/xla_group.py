@@ -246,14 +246,31 @@ class XlaGroup(BaseGroup):
         out = self.allreduce(ones)
         jax.block_until_ready(out)
 
+    # -- eager p2p ------------------------------------------------------
+    # Single-controller semantics: send() eagerly copies the tensor onto
+    # the destination rank's DEVICE (the actual D2D/ICI transfer — what
+    # p2p exists for) and parks it in a per-destination FIFO mailbox;
+    # recv(rank) pops the oldest tensor delivered to that rank. The
+    # reference's worker-resident send/recv (collective.py:541-625) maps
+    # to StoreGroup across processes; inside jitted programs use
+    # lax.ppermute.
     def send(self, tensors, opts: SendOptions):
-        raise NotImplementedError(
-            "p2p inside one process is a device_put; use ppermute inside "
-            "jitted programs, or a StoreGroup across processes"
-        )
+        if not hasattr(self, "_p2p_mailbox"):
+            self._p2p_mailbox = {}
+        tensor = tensors[0] if isinstance(tensors, (list, tuple)) else tensors
+        dst_dev = self._devices[opts.dst_rank]
+        delivered = jax.device_put(jnp.asarray(tensor), dst_dev)
+        self._p2p_mailbox.setdefault(opts.dst_rank, []).append(delivered)
 
-    def recv(self, tensors, opts: RecvOptions):
-        raise NotImplementedError(
-            "p2p inside one process is a device_put; use ppermute inside "
-            "jitted programs, or a StoreGroup across processes"
-        )
+    def recv(self, tensors_or_opts=None, opts: RecvOptions = None):
+        # tolerate both recv(opts) and recv(tensors, opts) call shapes
+        if opts is None:
+            opts = tensors_or_opts
+        box = getattr(self, "_p2p_mailbox", {})
+        queue = box.get(opts.src_rank)
+        if not queue:
+            raise RuntimeError(
+                f"no pending p2p message for rank {opts.src_rank} "
+                f"(single-controller group: send() must precede recv())"
+            )
+        return queue.pop(0)

@@ -186,3 +186,31 @@ class TestModuleAPI:
         )
         out = ray_tpu.get([a.reduce_val.remote("decl") for a in actors])
         np.testing.assert_allclose(out[0], [2.0])
+
+
+def test_xla_group_eager_p2p():
+    """Eager send/recv on the single-controller group: send() lands the
+    tensor on the destination rank's device; recv(rank) drains that
+    rank's mailbox FIFO (was NotImplementedError through round 2)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.util.collective.collective_group.xla_group import XlaGroup
+    from ray_tpu.util.collective.types import RecvOptions, SendOptions
+
+    devs = jax.devices()[:4]
+    g = XlaGroup(world_size=len(devs), rank=0, group_name="p2p", devices=devs)
+    a = jnp.arange(8.0)
+    b = jnp.arange(8.0) * 2
+    g.send([a], SendOptions(dst_rank=2))
+    g.send([b], SendOptions(dst_rank=2))
+    out1 = g.recv(RecvOptions(src_rank=2))
+    out2 = g.recv(RecvOptions(src_rank=2))
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(a))
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(b))
+    assert out1.devices() == {devs[2]}
+    import pytest
+
+    with pytest.raises(RuntimeError):
+        g.recv(RecvOptions(src_rank=1))

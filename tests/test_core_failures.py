@@ -56,6 +56,111 @@ def test_cancel_pending_task(ray_start_regular):
         ray_tpu.get(t, timeout=10)
 
 
+def test_force_cancel_running_task(ray_start_regular):
+    """force=True kills the executing worker; the ref resolves to
+    TaskCancelledError and the task is NOT retried."""
+
+    @ray_tpu.remote(max_retries=3)
+    def spin(path):
+        open(path, "a").write("x")
+        time.sleep(60)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "started")
+        ref = spin.remote(marker)
+        deadline = time.time() + 20
+        while not os.path.exists(marker) and time.time() < deadline:
+            time.sleep(0.05)
+        assert os.path.exists(marker)
+        ray_tpu.cancel(ref, force=True)
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(ref, timeout=30)
+        # not retried despite max_retries=3
+        time.sleep(1.0)
+        assert open(marker).read() == "x"
+
+
+def test_cooperative_cancel_running_task(ray_start_regular):
+    """force=False interrupts the worker with SIGINT (KeyboardInterrupt
+    inside the task) — worker survives and serves again."""
+
+    @ray_tpu.remote
+    def spin(path):
+        open(path, "a").write("x")
+        time.sleep(60)
+
+    @ray_tpu.remote
+    def ping():
+        return "alive"
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "started")
+        ref = spin.remote(marker)
+        deadline = time.time() + 20
+        while not os.path.exists(marker) and time.time() < deadline:
+            time.sleep(0.05)
+        ray_tpu.cancel(ref, force=False)
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(ref, timeout=30)
+        assert ray_tpu.get(ping.remote(), timeout=30) == "alive"
+
+
+def test_cancel_queued_actor_call(ray_start_regular):
+    @ray_tpu.remote
+    class Slow:
+        def block(self):
+            time.sleep(20)
+            return "blocked"
+
+        def quick(self):
+            return "quick"
+
+    a = Slow.remote()
+    ray_tpu.get(a.quick.remote(), timeout=30)  # actor alive
+    r1 = a.block.remote()
+    time.sleep(0.3)
+    r2 = a.quick.remote()  # queued behind block()
+    ray_tpu.cancel(r2)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(r2, timeout=10)
+    ray_tpu.kill(a)
+
+
+def test_actor_restart_on_crash(ray_start_regular):
+    @ray_tpu.remote(max_restarts=2)
+    class Fragile:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def crash(self):
+            os._exit(1)
+
+    a = Fragile.remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 1
+    crash_ref = a.crash.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(crash_ref, timeout=30)
+    # restarted incarnation: state reset, still serving
+    deadline = time.time() + 30
+    val = None
+    while time.time() < deadline:
+        try:
+            val = ray_tpu.get(a.bump.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.2)
+    assert val == 1
+    ray_tpu.kill(a)
+
+
 def test_application_error_not_retried(ray_start_regular):
     calls_file = "/tmp/ray_tpu_test_calls_%d" % os.getpid()
     if os.path.exists(calls_file):
