@@ -2,20 +2,24 @@
 
 Parity: python/ray/rllib/ core shape (AlgorithmConfig builder →
 Algorithm.train(); EnvRunner actor fan-out; jitted Learner update).
-PPO first; the actor/learner pattern generalizes (§2.5).
+PPO (sync batch) + IMPALA (async actor-learner with V-trace, §2.5).
 """
 
 from .algorithm import Algorithm
 from .core import MLPSpec, forward, init_mlp_module, sample_actions
 from .env_runner import SingleAgentEnvRunner
+from .impala import IMPALA, IMPALAConfig, vtrace
 from .ppo import PPOConfig
 
 __all__ = [
     "Algorithm",
+    "IMPALA",
+    "IMPALAConfig",
     "MLPSpec",
     "PPOConfig",
     "SingleAgentEnvRunner",
     "forward",
     "init_mlp_module",
     "sample_actions",
+    "vtrace",
 ]

@@ -1,0 +1,312 @@
+"""IMPALA: async actor-learner with V-trace off-policy correction.
+
+Parity: python/ray/rllib/algorithms/impala/ — EnvRunner actors sample
+continuously with (slightly stale) behavior policies while the learner
+consumes completed rollouts as they arrive; V-trace (Espeholt et al.
+2018) corrects the off-policyness. TPU-native shape (§2.5): the entire
+V-trace + SGD update is one jitted program; asynchrony lives in the
+actor fan-out (`ray_tpu.wait` on whichever runner finishes first), not
+in framework queue threads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .core import MLPSpec, forward
+
+
+@dataclass
+class IMPALAConfig:
+    """Builder (reference: impala/impala.py IMPALAConfig)."""
+
+    env: Optional[Union[str, Callable]] = None
+    num_env_runners: int = 2
+    num_envs_per_env_runner: int = 2
+    rollout_fragment_length: int = 64
+    lr: float = 5e-3
+    gamma: float = 0.99
+    vtrace_clip_rho: float = 1.0
+    vtrace_clip_c: float = 1.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: float = 1.0
+    # learner updates consumed per train() iteration (each is one
+    # runner's completed rollout — the async unit)
+    updates_per_iteration: int = 4
+    hiddens: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def environment(self, env) -> "IMPALAConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None) -> "IMPALAConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "IMPALAConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown IMPALA training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed=None) -> "IMPALAConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build_algo(self):
+        return IMPALA(self)
+
+    build = build_algo
+
+
+def vtrace(
+    behavior_logp, target_logp, rewards, dones, values, bootstrap_value,
+    *, gamma, clip_rho, clip_c,
+):
+    """V-trace targets (Espeholt et al. 2018, eqs. 1-2). All inputs
+    time-major (T, B); returns (vs (T, B), pg_advantages (T, B))."""
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_c = jnp.minimum(clip_rho, rho)
+    c = jnp.minimum(clip_c, rho)
+    nonterminal = 1.0 - dones
+    values_tp1 = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    deltas = rho_c * (rewards + gamma * nonterminal * values_tp1 - values)
+
+    def step(acc, xs):
+        delta_t, c_t, nt_t = xs
+        acc = delta_t + gamma * nt_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        step,
+        jnp.zeros_like(bootstrap_value),
+        (deltas, c, nonterminal),
+        reverse=True,
+    )
+    vs = values + vs_minus_v
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho_c * (rewards + gamma * nonterminal * vs_tp1 - values)
+    return vs, pg_adv
+
+
+_UPDATE_CACHE: dict = {}
+
+
+def make_impala_update(config: IMPALAConfig, spec: MLPSpec):
+    """(optimizer, jitted update) — V-trace loss + one SGD step over a
+    single runner's rollout. Cached per (hyperparams, spec)."""
+    import optax
+
+    key = (
+        config.lr, config.gamma, config.vtrace_clip_rho,
+        config.vtrace_clip_c, config.vf_loss_coeff, config.entropy_coeff,
+        config.grad_clip, spec,
+    )
+    cached = _UPDATE_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(config.grad_clip),
+        optax.adam(config.lr),
+    )
+
+    def loss_fn(params, batch):
+        T, B = batch["actions"].shape
+        logits, values = forward(params, batch["obs"])  # (T, B, A), (T, B)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        bootstrap = forward(params, batch["final_obs"])[1]  # (B,)
+        vs, pg_adv = vtrace(
+            batch["logp_mu"], jax.lax.stop_gradient(logp),
+            batch["rewards"], batch["dones"],
+            jax.lax.stop_gradient(values), jax.lax.stop_gradient(bootstrap),
+            gamma=config.gamma,
+            clip_rho=config.vtrace_clip_rho,
+            clip_c=config.vtrace_clip_c,
+        )
+        pi_loss = -jnp.mean(jax.lax.stop_gradient(pg_adv) * logp)
+        vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (
+            pi_loss
+            + config.vf_loss_coeff * vf_loss
+            - config.entropy_coeff * entropy
+        )
+        return total, {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.mean(
+                jnp.exp(jax.lax.stop_gradient(logp) - batch["logp_mu"])
+            ),
+        }
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    _UPDATE_CACHE[key] = (optimizer, update)
+    return optimizer, update
+
+
+class IMPALA:
+    """Async actor-learner driver (reference: impala.py training_step —
+    sample non-blockingly from whichever runner is done, update, push
+    fresh weights back to THAT runner only)."""
+
+    def __init__(self, config: IMPALAConfig):
+        import numpy as np
+
+        import ray_tpu
+
+        from .core import init_mlp_module
+        from .env_runner import SingleAgentEnvRunner
+
+        if config.env is None:
+            raise ValueError("config.environment(env) is required")
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self.config = config
+        self._ray = ray_tpu
+        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self.env_runners = [
+            runner_cls.remote(
+                config.env,
+                config.num_envs_per_env_runner,
+                config.seed + 1000 * i,
+                config.rollout_fragment_length,
+                config.gamma,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        obs_dim = ray_tpu.get(self.env_runners[0].obs_space_dim.remote())
+        num_actions = ray_tpu.get(self.env_runners[0].num_actions.remote())
+        self.spec = MLPSpec(obs_dim, num_actions, tuple(config.hiddens))
+        self.params = init_mlp_module(jax.random.PRNGKey(config.seed), self.spec)
+        self.optimizer, self._update = make_impala_update(config, self.spec)
+        self.opt_state = self.optimizer.init(self.params)
+        self.iteration = 0
+        self._timesteps = 0
+        self._seed_counter = 0
+        # async pipeline: every runner always has a sample() in flight
+        self._inflight: Dict[Any, int] = {}
+        self._np = np
+
+    def _host_params(self):
+        return jax.tree.map(self._np.asarray, self.params)
+
+    def _submit(self, runner_idx: int):
+        self._seed_counter += 1
+        ref = self.env_runners[runner_idx].sample.remote(
+            self._host_params(), self.config.seed + self._seed_counter * 97
+        )
+        self._inflight[ref] = runner_idx
+
+    def train(self) -> Dict[str, Any]:
+        np = self._np
+        ray = self._ray
+        if not self._inflight:
+            for i in range(len(self.env_runners)):
+                self._submit(i)
+        episode_returns = []
+        metrics = {}
+        for _ in range(self.config.updates_per_iteration):
+            ready, _ = ray.wait(
+                list(self._inflight.keys()), num_returns=1, timeout=120
+            )
+            ref = ready[0]
+            runner_idx = self._inflight.pop(ref)
+            rollout = ray.get(ref)
+            # learner consumes THIS runner's batch; runner immediately
+            # resamples with the post-update weights (async staleness <=
+            # one rollout — the IMPALA contract)
+            batch = {
+                "obs": rollout["obs"].reshape(
+                    *rollout["obs"].shape[:2], -1
+                ),
+                "actions": rollout["actions"],
+                "rewards": rollout["rewards"],
+                "dones": rollout["dones"],
+                "logp_mu": rollout["logp"],
+                "final_obs": rollout["final_obs"].reshape(
+                    rollout["final_obs"].shape[0], -1
+                ),
+            }
+            self.params, self.opt_state, metrics = self._update(
+                self.params, self.opt_state, batch
+            )
+            self._timesteps += int(batch["actions"].size)
+            episode_returns.extend(rollout["episode_returns"].tolist())
+            self._submit(runner_idx)
+        self.iteration += 1
+        result = {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "episode_return_mean": (
+                float(np.mean(episode_returns)) if episode_returns else float("nan")
+            ),
+            "num_episodes": len(episode_returns),
+        }
+        result.update({k: float(v) for k, v in metrics.items()})
+        return result
+
+    def compute_single_action(self, obs) -> int:
+        logits, _ = forward(self.params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(logits[0]))
+
+    def save(self, checkpoint_dir: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        state = {
+            "params": jax.tree.map(self._np.asarray, self.params),
+            "opt_state": jax.tree.map(self._np.asarray, self.opt_state),
+            "iteration": self.iteration,
+            "timesteps": self._timesteps,
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        for r in self.env_runners:
+            try:
+                self._ray.kill(r)
+            except Exception:
+                pass
+        self.env_runners = []

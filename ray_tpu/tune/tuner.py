@@ -116,6 +116,84 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restored_state: Optional[dict] = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def restore(
+        cls,
+        path: str,
+        trainable: Union[Callable, Any],
+        *,
+        resume_unfinished: bool = True,
+        restart_errored: bool = False,
+    ) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (reference: Tuner.restore + tune/execution/experiment_state.py).
+        Rehydrates the searcher/scheduler state and every trial's
+        config/status/last-checkpoint; unfinished trials continue from
+        their checkpoints, finished ones keep their results."""
+        import cloudpickle
+
+        state_path = os.path.join(path, "experiment_state.pkl")
+        with open(state_path, "rb") as f:
+            state = cloudpickle.load(f)
+        tuner = cls(
+            trainable,
+            param_space=state["param_space"],
+            tune_config=state["tune_config"],
+            run_config=state["run_config"],
+        )
+        for t in state["trials"]:
+            if t["status"] == "RUNNING" or (
+                t["status"] == "PENDING" and resume_unfinished
+            ):
+                t["status"] = "PENDING"  # relaunch from checkpoint
+            elif t["status"] == "ERROR" and restart_errored:
+                t["status"] = "PENDING"
+                t["error"] = None
+        if not resume_unfinished:
+            state["trials"] = [
+                t for t in state["trials"] if t["status"] != "PENDING"
+            ]
+        tuner._restored_state = state
+        return tuner
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "experiment_state.pkl"))
+
+    def _save_state(self, exp_dir, name, trials, counter, searcher, scheduler):
+        """Atomic experiment snapshot after every trial-state change —
+        the crash-consistency contract Tuner.restore relies on."""
+        import cloudpickle
+
+        state = {
+            "name": name,
+            "counter": counter,
+            "param_space": self.param_space,
+            "tune_config": self.tune_config,
+            "run_config": self.run_config,
+            "searcher": searcher,
+            "scheduler": scheduler,
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "status": t.status if t.status != "RUNNING" else "RUNNING",
+                    "last_metrics": t.last_metrics,
+                    "checkpoint_path": t.checkpoint_path,
+                    "error": t.error,
+                    "storage_dir": t.storage_dir,
+                    "iteration": t.iteration,
+                }
+                for t in trials.values()
+            ],
+        }
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, os.path.join(exp_dir, "experiment_state.pkl"))
 
     # ------------------------------------------------------------------
     def fit(self) -> ResultGrid:
@@ -125,19 +203,44 @@ class Tuner:
             ray_tpu.init(ignore_reinit_error=True)
 
         tc = self.tune_config
-        name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        restored = self._restored_state
+        if restored is not None:
+            name = restored["name"]
+        else:
+            name = self.run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
         exp_dir = os.path.join(os.path.expanduser(self.run_config.storage_path), name)
         os.makedirs(exp_dir, exist_ok=True)
 
-        searcher = tc.search_alg or BasicVariantGenerator(
-            self.param_space, num_samples=tc.num_samples, seed=tc.seed
-        )
-        scheduler = tc.scheduler or FIFOScheduler()
+        if restored is not None:
+            searcher = restored["searcher"]
+            scheduler = restored["scheduler"]
+        else:
+            searcher = tc.search_alg or BasicVariantGenerator(
+                self.param_space, num_samples=tc.num_samples, seed=tc.seed
+            )
+            scheduler = tc.scheduler or FIFOScheduler()
         scheduler.set_metric_and_mode(tc.metric, tc.mode)
 
         max_conc = tc.max_concurrent_trials or 4
         trials: Dict[str, _Trial] = {}
         counter = 0
+        resume_queue: List[_Trial] = []
+        if restored is not None:
+            counter = restored["counter"]
+            for t in restored["trials"]:
+                trial = _Trial(
+                    trial_id=t["trial_id"],
+                    config=t["config"],
+                    status=t["status"],
+                    last_metrics=t["last_metrics"],
+                    checkpoint_path=t["checkpoint_path"],
+                    error=t["error"],
+                    storage_dir=t["storage_dir"],
+                    iteration=t["iteration"],
+                )
+                trials[trial.trial_id] = trial
+                if trial.status == "PENDING":
+                    resume_queue.append(trial)
         # Custom searchers (e.g. Optuna) can suggest unboundedly; cap
         # them at num_samples. BasicVariantGenerator self-limits (grid ×
         # num_samples) and reports exhaustion via is_finished().
@@ -156,10 +259,19 @@ class Tuner:
                 return True
             return trial_cap is not None and counter >= trial_cap
 
+        dirty = True
         while True:
             # launch new trials up to the concurrency cap
             starved = False
             running = [t for t in trials.values() if t.status == "RUNNING"]
+            # restored unfinished trials resume first (from checkpoint)
+            while resume_queue and len(running) < max_conc:
+                trial = resume_queue.pop(0)
+                if hasattr(scheduler, "register_config"):
+                    scheduler.register_config(trial.trial_id, trial.config)
+                self._start_trial(trial, train_fn, resources)
+                running.append(trial)
+                dirty = True
             while not exhausted() and len(running) < max_conc:
                 trial_id = f"{name}_{counter:05d}"
                 cfg = searcher.suggest(trial_id)
@@ -173,6 +285,7 @@ class Tuner:
                 self._start_trial(trial, train_fn, resources)
                 trials[trial_id] = trial
                 running.append(trial)
+                dirty = True
 
             if not running:
                 # nothing in flight and the searcher has nothing to give
@@ -205,6 +318,7 @@ class Tuner:
                     trial.last_metrics = metrics
                     if row.get("checkpoint_path"):
                         trial.checkpoint_path = row["checkpoint_path"]
+                        dirty = True
                     decision = scheduler.on_result(trial.trial_id, metrics)
                     if decision == STOP:
                         ray.get(trial.actor.request_stop.remote())
@@ -229,13 +343,20 @@ class Tuner:
                     self._stop_actor(trial)
                     searcher.on_trial_complete(trial.trial_id, trial.last_metrics)
                     scheduler.on_trial_complete(trial.trial_id)
+                    dirty = True
                 elif poll["finished"]:
                     trial.status = "TERMINATED"
                     self._stop_actor(trial)
                     searcher.on_trial_complete(trial.trial_id, trial.last_metrics)
                     scheduler.on_trial_complete(trial.trial_id)
+                    dirty = True
+            if dirty:
+                # crash-consistent snapshot for Tuner.restore
+                self._save_state(exp_dir, name, trials, counter, searcher, scheduler)
+                dirty = False
             time.sleep(_POLL_S)
 
+        self._save_state(exp_dir, name, trials, counter, searcher, scheduler)
         results = [
             Result(
                 metrics=t.last_metrics,

@@ -167,3 +167,70 @@ def test_tuner_wraps_trainer(ray_start_4_cpus, storage):
         run_config=RunConfig(name="wrap", storage_path=storage),
     ).fit()
     assert results.get_best_result().metrics["out"] == 10
+
+
+def test_tuner_restore_resumes_from_checkpoints(ray_start_4_cpus, storage, tmp_path):
+    """Kill-and-resume (reference: Tuner.restore over
+    experiment_state.py): trials crash mid-run; Tuner.restore rehydrates
+    searcher/scheduler/trial state and continues each trial from its
+    last checkpoint instead of from scratch."""
+    crash_dir = str(tmp_path / "markers")
+    os.makedirs(crash_dir, exist_ok=True)
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_state()["i"] + 1 if ckpt else 0
+        marker = os.path.join(crash_dir, f"trial_{config['x']}")
+        for i in range(start, 6):
+            # record every executed step for the no-redo assertion
+            with open(marker, "a") as f:
+                f.write(f"{i},")
+            tune.report({"i": i}, checkpoint=Checkpoint.from_state({"i": i}))
+            if i == 2 and not os.path.exists(marker + ".crashed"):
+                open(marker + ".crashed", "w").close()
+                os._exit(1)  # hard crash mid-experiment
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1])},
+        tune_config=TuneConfig(metric="i", mode="max"),
+        run_config=RunConfig(name="resume_exp", storage_path=storage),
+    )
+    results = tuner.fit()
+    assert len(results.errors) == 2  # both trials crashed
+
+    exp_dir = os.path.join(storage, "resume_exp")
+    assert Tuner.can_restore(exp_dir)
+    restored = Tuner.restore(exp_dir, trainable, restart_errored=True)
+    results2 = restored.fit()
+    assert not results2.errors
+    assert len(results2) == 2  # no extra trials suggested after restore
+    for r in results2:
+        assert r.metrics["i"] == 5
+        assert r.checkpoint.to_state()["i"] == 5
+    # resumed from a checkpoint, not from scratch: early steps ran
+    # exactly once (only the step(s) after the last durable checkpoint
+    # may replay — that's the recovery contract)
+    for x in (0, 1):
+        steps = open(os.path.join(crash_dir, f"trial_{x}")).read()
+        executed = [int(s) for s in steps.strip(",").split(",")]
+        assert executed[-1] == 5
+        assert executed.count(0) == 1 and executed.count(1) == 1, executed
+        assert len(executed) <= 8, executed  # 6 steps + <=2 replays
+
+
+def test_tuner_restore_keeps_finished_results(ray_start_4_cpus, storage):
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="done_exp", storage_path=storage),
+    ).fit()
+    exp_dir = os.path.join(storage, "done_exp")
+    restored = Tuner.restore(exp_dir, trainable)
+    results = restored.fit()  # nothing to do: results come from state
+    assert len(results) == 2
+    assert results.get_best_result().metrics["score"] == 2
