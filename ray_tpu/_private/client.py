@@ -57,6 +57,9 @@ class CoreClient:
         self._seen_fns: Dict[str, Any] = {}
         self.task_queue: "queue.Queue" = queue.Queue()
         self.cancelled_tasks: set = set()  # task_ids to drop at dequeue
+        # client mode (ray_tpu.init(address=...)): no shared shm with the
+        # cluster — all puts travel inline through the hub connection
+        self.inline_only = False
         self._closed = False
         self.send(P.HELLO, {"role": role, "worker_id": worker_id,
                             "pid": os.getpid(), "node_id": self.node_id})
@@ -214,7 +217,7 @@ class CoreClient:
 
         header, buffers = dumps_oob(obj)
         nbytes = len(header) + sum(b.raw().nbytes for b in buffers)
-        if nbytes < INLINE_THRESHOLD:
+        if nbytes < INLINE_THRESHOLD or self.inline_only:
             if buffers:
                 blob = dumps_inline((header, [b.raw().tobytes() for b in buffers]))
             else:

@@ -1,0 +1,79 @@
+"""Framework-wide config table with env overrides.
+
+Parity: src/ray/common/ray_config_def.h (224 RAY_CONFIG entries read
+from RAY_xxx env vars) — a single typed table every subsystem reads
+instead of scattering magic numbers. Override any knob with
+RAY_TPU_<NAME>=<value>; values are parsed to the default's type.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_DEFAULTS: Dict[str, Any] = {
+    # object plane
+    "inline_object_threshold": 100 * 1024,   # plasma-vs-inline cutoff
+    "object_store_memory": 0.0,              # 0 = unlimited (no spill)
+    # scheduling / workers
+    "worker_reap_period_s": 1.0,
+    "max_pending_spawns_per_node": 32,
+    # rpc
+    "request_retry_period_s": 2.0,
+    "client_batch_max": 128,
+    # memory monitor (reference: common/memory_monitor.h + raylet
+    # worker_killing_policy.cc) — kill the newest worker past the cap
+    "memory_monitor_period_s": 1.0,
+    "memory_usage_threshold": 0.0,           # bytes/worker; 0 = disabled
+    # observability
+    "task_events_max": 20000,
+    # test hooks
+    "chaos_drop": "",
+}
+
+
+class _Config:
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        for key, default in _DEFAULTS.items():
+            env = os.environ.get(f"RAY_TPU_{key.upper()}")
+            if env is None:
+                self._values[key] = default
+            else:
+                self._values[key] = self._parse(env, default)
+
+    @staticmethod
+    def _parse(raw: str, default: Any) -> Any:
+        if isinstance(default, bool):
+            return raw.lower() in ("1", "true", "yes")
+        if isinstance(default, int):
+            return int(float(raw))
+        if isinstance(default, float):
+            return float(raw)
+        return raw
+
+    def __getattr__(self, key: str) -> Any:
+        try:
+            return self._values[key]
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def set(self, key: str, value: Any) -> None:
+        """Test/driver override (before the consuming subsystem starts)."""
+        self._values[key] = value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+RAY_TPU_CONFIG = _Config()
+
+
+def reload() -> None:
+    """Re-read env overrides (a new Hub calls this so per-test env
+    changes take effect without a fresh interpreter)."""
+    global RAY_TPU_CONFIG
+    RAY_TPU_CONFIG = _Config()

@@ -125,6 +125,7 @@ class WorkerEntry:
     conn: Any = None
     proc: Any = None
     node_id: str = "node0"
+    runtime_env_hash: str = ""  # workers only serve matching runtime envs
     state: str = "starting"  # starting | idle | busy | actor | dead
     current_task: Optional[TaskSpec] = None
     actor_id: Optional[bytes] = None
@@ -224,8 +225,12 @@ class Hub:
             _tempfile.gettempdir(), "ray_tpu_spill_" + os.path.basename(session_dir)
         )
 
-        # chaos config is re-read per hub so tests can set the env after
-        # the module was first imported (reference: rpc_chaos.h Init)
+        # config table + chaos are re-read per hub so tests can set env
+        # after first import (reference: ray_config_def.h + rpc_chaos.h)
+        from . import config as _config_mod
+
+        _config_mod.reload()
+        self.config = _config_mod.RAY_TPU_CONFIG
         self._chaos = _parse_chaos()
         self.session_dir = session_dir
         os.makedirs(session_dir, exist_ok=True)
@@ -288,9 +293,7 @@ class Hub:
         # observability plane (reference: stats/metric.h registry +
         # core_worker/task_event_buffer.h -> GCS task events)
         self.metrics: Dict[Tuple[str, tuple], dict] = {}
-        self.task_events: deque = deque(maxlen=int(
-            os.environ.get("RAY_TPU_TASK_EVENTS_MAX", 20000)
-        ))
+        self.task_events: deque = deque(maxlen=int(self.config.task_events_max))
         self._task_event_index: Dict[bytes, dict] = {}
         self.client_conns: List[Any] = []
         self.driver_conn = None
@@ -315,7 +318,11 @@ class Hub:
         self._send(conn, P.REPLY, dict(payload, req_id=req_id))
 
     def _run(self):
-        self._add_timer(1.0, self._reap_workers)
+        self._add_timer(self.config.worker_reap_period_s, self._reap_workers)
+        if self.config.memory_usage_threshold > 0:
+            self._add_timer(
+                self.config.memory_monitor_period_s, self._memory_monitor
+            )
         lsock = self.listener._listener._socket  # raw fd for readiness polling
         while self._running:
             now = time.monotonic()
@@ -418,8 +425,10 @@ class Hub:
             if node is not None:
                 node.spawning = max(0, node.spawning - 1)
             self._dispatch()
-        else:
+        elif p["role"] == "driver":
             self.driver_conn = conn
+        # role == "client": a remote driver (Ray Client parity) — its
+        # disconnect must NOT tear the session down
 
     def _on_register_node(self, conn, p):
         node = NodeEntry(
@@ -955,7 +964,8 @@ class Hub:
     def _sched_class(self, spec: TaskSpec) -> tuple:
         pg = spec.options.get("placement_group")
         res_key = tuple(sorted(spec.resources.items()))
-        return (res_key, pg[0] if pg else None, pg[1] if pg else None)
+        return (res_key, pg[0] if pg else None, pg[1] if pg else None,
+                spec.options.get("runtime_env_hash", ""))
 
     def _enqueue_runnable(self, spec: TaskSpec):
         key = self._sched_class(spec)
@@ -1048,10 +1058,9 @@ class Hub:
                     # worker, the rest of the queue wants one too (keeps
                     # warm-up spawning parallel, not one-per-pass)
                     if self._last_spawn_node is not None and len(q) > 1:
-                        self._spawn_wants[self._last_spawn_node] = (
-                            self._spawn_wants.get(self._last_spawn_node, 0)
-                            + len(q) - 1
-                        )
+                        self._spawn_wants.setdefault(
+                            self._last_spawn_node, []
+                        ).extend([self._last_spawn_env] * (len(q) - 1))
                     break
             if not q:
                 empty_keys.append(key)
@@ -1059,13 +1068,17 @@ class Hub:
             if not self.runnable.get(key):
                 self.runnable.pop(key, None)
         # spawn workers where placement deferred for lack of an idle worker
-        for node_id, want in self._spawn_wants.items():
+        for node_id, wants in self._spawn_wants.items():
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
                 continue
-            can = node.max_workers - self._node_worker_count(node_id)
-            for _ in range(max(0, min(want - node.spawning, can))):
-                self._spawn_worker(node)
+            can = min(
+                len(wants) - node.spawning,
+                node.max_workers - self._node_worker_count(node_id),
+            )
+            for renv, renv_hash in wants[:max(0, can)]:
+                self._spawn_worker(node, runtime_env=renv,
+                                   renv_hash=renv_hash)
 
     def _try_place(self, spec: TaskSpec) -> str:
         pools = self._effective_pools(spec)
@@ -1119,10 +1132,12 @@ class Hub:
         # idle workers don't help a fresh process).
         for node, _ in candidates:
             if n_chips == 0 or len(node.free_tpu_chips) >= n_chips:
-                self._spawn_wants[node.node_id] = (
-                    self._spawn_wants.get(node.node_id, 0) + 1
+                self._spawn_wants.setdefault(node.node_id, []).append(
+                    (spec.options.get("runtime_env"),
+                     spec.options.get("runtime_env_hash", ""))
                 )
                 self._last_spawn_node = node.node_id
+                self._last_spawn_env = self._spawn_wants[node.node_id][-1]
                 break
         return "defer"
 
@@ -1130,10 +1145,12 @@ class Hub:
         """Pick an idle worker ON THIS NODE; TPU tasks require chip
         affinity (a worker pinned to exactly n chips, or a fresh worker +
         n free chips on the node)."""
+        need_env = spec.options.get("runtime_env_hash", "")
         if n_chips > 0:
             fresh = None
             for w in self.workers.values():
-                if w.state != "idle" or w.node_id != node.node_id:
+                if (w.state != "idle" or w.node_id != node.node_id
+                        or w.runtime_env_hash != need_env):
                     continue
                 if w.pinned_chips is not None and len(w.pinned_chips) == n_chips:
                     return w, w.pinned_chips
@@ -1144,7 +1161,8 @@ class Hub:
             return None, ()
         best = None
         for w in self.workers.values():
-            if w.state != "idle" or w.node_id != node.node_id:
+            if (w.state != "idle" or w.node_id != node.node_id
+                    or w.runtime_env_hash != need_env):
                 continue
             # prefer non-TPU-pinned workers for CPU tasks, and fn cache hits
             if spec.fn_id in w.seen_fns and w.pinned_chips is None:
@@ -1197,26 +1215,30 @@ class Hub:
             paths.append(os.environ["PYTHONPATH"])
         return os.pathsep.join(dict.fromkeys(paths))
 
-    def _spawn_worker(self, node: NodeEntry):
+    def _spawn_worker(self, node: NodeEntry, runtime_env=None,
+                      renv_hash: str = ""):
+        import json as _json
+
         wid = WorkerID.generate().hex()
         node.spawning += 1
+        renv_json = _json.dumps(runtime_env) if runtime_env else ""
         if node.agent_conn is not None:
             # remote host: the node agent forks the worker there
             self.workers[wid] = WorkerEntry(
-                worker_id=wid, state="starting", node_id=node.node_id
+                worker_id=wid, state="starting", node_id=node.node_id,
+                runtime_env_hash=renv_hash,
             )
+            env = dict(
+                self.worker_env,
+                RAY_TPU_HUB_ADDR=self.addr,
+                RAY_TPU_WORKER_ID=wid,
+                PYTHONPATH=self._worker_pythonpath(),
+            )
+            if renv_json:
+                env["RAY_TPU_RUNTIME_ENV"] = renv_json
             self._send(
-                node.agent_conn,
-                P.SPAWN_WORKER,
-                {
-                    "worker_id": wid,
-                    "env": dict(
-                        self.worker_env,
-                        RAY_TPU_HUB_ADDR=self.addr,
-                        RAY_TPU_WORKER_ID=wid,
-                        PYTHONPATH=self._worker_pythonpath(),
-                    ),
-                },
+                node.agent_conn, P.SPAWN_WORKER,
+                {"worker_id": wid, "env": env},
             )
             return
         env = dict(os.environ)
@@ -1226,13 +1248,16 @@ class Hub:
         env["RAY_TPU_WORKER_ID"] = wid
         env["RAY_TPU_NODE_ID"] = node.node_id
         env["PYTHONPATH"] = self._worker_pythonpath()
+        if renv_json:
+            env["RAY_TPU_RUNTIME_ENV"] = renv_json
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_process"],
             env=env,
             cwd=os.getcwd(),
         )
         self.workers[wid] = WorkerEntry(
-            worker_id=wid, proc=proc, state="starting", node_id=node.node_id
+            worker_id=wid, proc=proc, state="starting", node_id=node.node_id,
+            runtime_env_hash=renv_hash,
         )
 
     def _reap_workers(self):
@@ -1254,7 +1279,42 @@ class Hub:
             self.workers.pop(w.worker_id, None)
         if dead:
             self._dispatch()
-        self._add_timer(1.0, self._reap_workers)
+        self._add_timer(self.config.worker_reap_period_s, self._reap_workers)
+
+    def _worker_rss(self, pid: int) -> int:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+        except (OSError, IndexError, ValueError):
+            return 0
+
+    def _memory_monitor(self):
+        """Kill local workers whose RSS exceeds the per-worker cap
+        (reference: common/memory_monitor.h feeding the raylet's
+        worker-killing policy, worker_killing_policy.cc — we use its
+        newest-first ordering: the most recently started offender dies,
+        preserving long-running work)."""
+        cap = self.config.memory_usage_threshold
+        offenders = [
+            w for w in self.workers.values()
+            if w.proc is not None and w.conn is not None
+            and self._worker_rss(w.proc.pid) > cap
+        ]
+        if offenders:
+            from ..exceptions import OutOfMemoryError
+
+            victim = offenders[-1]  # newest registered
+            sys.stderr.write(
+                f"[ray_tpu] memory monitor: worker {victim.worker_id} rss "
+                f"exceeds {cap:.0f} bytes; killing\n"
+            )
+            spec = victim.current_task
+            if spec is not None:
+                # OOM kills don't burn crash retries silently: fail fast
+                spec.retries_left = 0
+                spec.options["_oom"] = True
+            self._kill_worker(victim)
+        self._add_timer(self.config.memory_monitor_period_s, self._memory_monitor)
 
     def _on_task_done(self, conn, p):
         wid = self.conn_to_worker.get(conn)
@@ -1570,6 +1630,12 @@ class Hub:
                 from ..exceptions import TaskCancelledError
 
                 self._fail_task(spec, TaskCancelledError("task was cancelled"))
+            elif spec.options.get("_oom"):
+                from ..exceptions import OutOfMemoryError
+
+                self._fail_task(spec, OutOfMemoryError(
+                    "worker exceeded the per-worker memory threshold "
+                    f"({self.config.memory_usage_threshold:.0f} bytes)"))
             elif spec.retries_left > 0:
                 spec.retries_left -= 1
                 self._enqueue_runnable(spec)

@@ -77,7 +77,11 @@ def init(
     object_store_memory: Optional[float] = None,
     **kwargs,
 ):
-    """Start the single-host runtime (hub thread + on-demand worker pool)."""
+    """Start the runtime (hub thread + on-demand worker pool), or — with
+    ``address="tcp://host:port"`` — connect to an EXISTING cluster as a
+    client (reference: Ray Client, ray.init("ray://...") through
+    util/client/: no local runtime; all values travel inline through the
+    control connection, large results are fetched via the object plane)."""
     global _client, _hub, _session_dir
     with _lock:
         if _client is not None:
@@ -85,6 +89,22 @@ def init(
                 return RuntimeContext()
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
         import sys
+
+        if address:
+            import uuid as _uuid
+
+            scratch = os.path.join(
+                tempfile.gettempdir(), f"ray_tpu_client_{_uuid.uuid4().hex[:8]}"
+            )
+            os.makedirs(scratch, exist_ok=True)
+            _session_dir = scratch
+            _client = CoreClient(
+                address, scratch, role="client",
+                worker_id=f"client_{os.getpid()}",
+            )
+            _client.inline_only = True  # no shared /dev/shm with the cluster
+            atexit.register(shutdown)
+            return RuntimeContext()
 
         # The hub thread shares this process's GIL; a shorter switch interval
         # keeps control-plane latency low under CPU-bound driver code.

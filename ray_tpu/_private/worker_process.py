@@ -312,12 +312,52 @@ class WorkerRuntime:
             self._run_actor_method(p)
 
 
+def _setup_runtime_env(client, session_dir: str) -> None:
+    """Materialize this worker's runtime env (reference: the runtime-env
+    agent's env-context application, runtime_env_agent.py:303): env_vars
+    into the process env; working_dir fetched by URI from the cluster KV
+    once per content hash (cached extract dir) then chdir + sys.path."""
+    import json
+
+    renv_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if not renv_json:
+        return
+    renv = json.loads(renv_json)
+    for k, v in (renv.get("env_vars") or {}).items():
+        os.environ[k] = v
+    uri = renv.get("working_dir_uri")
+    if uri:
+        import zipfile
+
+        target = os.path.join(session_dir, "runtime_envs", uri)
+        if not os.path.isdir(target):
+            blob = client.kv_get(f"__runtime_env_pkg__{uri}".encode())
+            if blob is None:
+                raise RuntimeError(f"runtime env package {uri} missing from KV")
+            tmp = target + f".tmp.{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            import io
+
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.replace(tmp, target)
+            except OSError:
+                # another worker won the race; use its copy
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        os.chdir(target)
+        sys.path.insert(0, target)
+
+
 def main():
     sys.setswitchinterval(0.001)
     hub_addr = os.environ["RAY_TPU_HUB_ADDR"]
     session_dir = os.environ["RAY_TPU_SESSION_DIR"]
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     client = CoreClient(hub_addr, session_dir, role="worker", worker_id=worker_id)
+    _setup_runtime_env(client, session_dir)
 
     # make ray_tpu.* API work inside tasks (auto-connect)
     from . import worker as worker_mod

@@ -15,7 +15,12 @@ from typing import Any, Dict, Optional
 from ._private.ids import ActorID
 from ._private.serialization import dumps_function
 from .object_ref import ObjectRef
-from .remote_function import canonical_resources, encode_args, scheduling_options
+from .remote_function import (
+    canonical_resources,
+    encode_args,
+    process_runtime_env,
+    scheduling_options,
+)
 
 
 class ActorMethod:
@@ -141,6 +146,7 @@ class ActorClass:
         args_kind, args_payload, deps = encode_args(client, args, kwargs)
         resources = canonical_resources(opts, is_actor=True)
         options = scheduling_options(opts)
+        process_runtime_env(client, opts, options)
         options["max_restarts"] = opts.get("max_restarts", 0)
         options["max_concurrency"] = opts.get("max_concurrency", 1)
         if opts.get("name"):

@@ -1,0 +1,143 @@
+"""Dashboard: the cluster's HTTP observability surface.
+
+Parity: python/ray/dashboard/ (head.py:46 DashboardHead + modules) —
+TPU-native scope: the operational API, not a React frontend. One aiohttp
+server exposes the state API, metrics (Prometheus exposition), the
+chrome-trace timeline, and job submission/inspection:
+
+    GET  /api/cluster_status     nodes + aggregate resources
+    GET  /api/nodes|actors|tasks|workers|objects|placement_groups
+    GET  /api/timeline           chrome://tracing JSON
+    GET  /metrics                Prometheus text
+    GET  /api/jobs               job table
+    POST /api/jobs               {"entrypoint": ...} -> {"job_id": ...}
+    GET  /api/jobs/{id}          status
+    GET  /api/jobs/{id}/logs     captured driver output
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._thread: Optional[threading.Thread] = None
+        self._loop = None
+        self._started = threading.Event()
+
+    # ------------------------------------------------------------------
+    def start(self) -> "Dashboard":
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True, name="ray-tpu-dashboard"
+        )
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("dashboard failed to start within 10s")
+        return self
+
+    def _client(self):
+        from ray_tpu._private import worker
+
+        return worker.get_client()
+
+    def _serve(self):
+        import asyncio
+
+        from aiohttp import web
+
+        async def cluster_status(request):
+            client = self._client()
+            return web.json_response(
+                {
+                    "nodes": client.list_state("nodes"),
+                    "resources_total": client.cluster_resources(False),
+                    "resources_available": client.cluster_resources(True),
+                }
+            )
+
+        async def list_kind(request):
+            kind = request.match_info["kind"]
+            allowed = {
+                "nodes", "actors", "tasks", "workers", "objects",
+                "placement_groups",
+            }
+            if kind not in allowed:
+                raise web.HTTPNotFound(text=f"unknown kind {kind}")
+            return web.json_response(self._client().list_state(kind))
+
+        async def timeline(request):
+            return web.json_response(self._client().list_state("timeline"))
+
+        async def metrics(request):
+            from ray_tpu.util.metrics import prometheus_text
+
+            return web.Response(text=prometheus_text(),
+                                content_type="text/plain")
+
+        def _jobs_client():
+            from ray_tpu.job_submission import JobSubmissionClient
+
+            return JobSubmissionClient()
+
+        async def jobs_list(request):
+            return web.json_response(_jobs_client().list_jobs())
+
+        async def jobs_submit(request):
+            body = await request.json()
+            job_id = _jobs_client().submit_job(
+                entrypoint=body["entrypoint"],
+                submission_id=body.get("submission_id"),
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"),
+            )
+            return web.json_response({"job_id": job_id})
+
+        async def job_status(request):
+            return web.json_response(
+                _jobs_client().get_job_info(request.match_info["job_id"])
+            )
+
+        async def job_logs(request):
+            return web.Response(
+                text=_jobs_client().get_job_logs(request.match_info["job_id"]),
+                content_type="text/plain",
+            )
+
+        app = web.Application()
+        # literal routes BEFORE the /api/{kind} catch-all
+        app.router.add_get("/api/cluster_status", cluster_status)
+        app.router.add_get("/api/timeline", timeline)
+        app.router.add_get("/api/jobs", jobs_list)
+        app.router.add_post("/api/jobs", jobs_submit)
+        app.router.add_get("/api/jobs/{job_id}", job_status)
+        app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
+        app.router.add_get("/api/{kind}", list_kind)
+        app.router.add_get("/metrics", metrics)
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        runner = web.AppRunner(app)
+        self._loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        self._loop.run_until_complete(site.start())
+        self._started.set()
+        self._loop.run_forever()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    """Start (or return) the process-wide dashboard server."""
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port).start()
+    return _dashboard

@@ -109,6 +109,52 @@ def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def process_runtime_env(client, opts: Dict[str, Any], out: Dict[str, Any]) -> None:
+    """Package a runtime_env for the hub (reference: the runtime-env
+    agent's URI flow, _private/runtime_env/agent/runtime_env_agent.py:167
+    + working_dir plugin): env_vars travel inline; working_dir is zipped
+    once per content hash into the cluster KV (the GCS-KV upload path)
+    and workers materialize it from the URI with local caching."""
+    renv = opts.get("runtime_env")
+    if not renv:
+        return
+    import hashlib
+    import io
+    import json
+    import os
+    import zipfile
+
+    processed: Dict[str, Any] = {}
+    if renv.get("env_vars"):
+        processed["env_vars"] = {
+            str(k): str(v) for k, v in renv["env_vars"].items()
+        }
+    wd = renv.get("working_dir")
+    if wd:
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            for root, _, files in os.walk(wd):
+                for fname in sorted(files):
+                    full = os.path.join(root, fname)
+                    zf.write(full, os.path.relpath(full, wd))
+        blob = buf.getvalue()
+        uri = hashlib.sha1(blob).hexdigest()[:16]
+        client.kv_put(f"__runtime_env_pkg__{uri}".encode(), blob,
+                      overwrite=True)
+        processed["working_dir_uri"] = uri
+    unknown = set(renv) - {"env_vars", "working_dir"}
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)} (supported: "
+            "env_vars, working_dir; pip/conda need egress this "
+            "environment does not have)"
+        )
+    out["runtime_env"] = processed
+    out["runtime_env_hash"] = hashlib.sha1(
+        json.dumps(processed, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
 class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._fn = fn
@@ -152,6 +198,7 @@ class RemoteFunction:
         num_returns = opts.get("num_returns", 1)
         resources = canonical_resources(opts, is_actor=False)
         options = scheduling_options(opts)
+        process_runtime_env(client, opts, options)
         if num_returns == "streaming":
             from .object_ref import ObjectRefGenerator
 

@@ -1,0 +1,73 @@
+"""Public TPU pod helpers.
+
+Parity: python/ray/util/accelerators/tpu.py:7-33
+(get_current_pod_name / get_current_pod_worker_count /
+get_num_tpu_chips_on_node over TPUAcceleratorManager). Detection reads
+the standard TPU VM environment (TPU_NAME, TPU_WORKER_HOSTNAMES,
+TPU_ACCELERATOR_TYPE / PALLAS_AXON_TPU_GEN) — the GCE metadata server
+the reference also falls back to is unreachable in air-gapped pods, so
+env is authoritative here.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# chips per host by generation (public TPU VM shapes)
+_CHIPS_PER_HOST = {"v4": 4, "v5e": 8, "v5p": 4, "v5litepod": 8, "v6e": 8}
+
+
+def get_current_pod_name() -> Optional[str]:
+    """The TPU pod's name resource (gang-affinity key: the reference
+    exposes TPU-{name} as a custom resource for pod-wide placement)."""
+    name = os.environ.get("TPU_NAME") or os.environ.get("TPU_POD_NAME")
+    return name or None
+
+
+def get_current_pod_worker_count() -> int:
+    """Number of hosts in this pod (1 on a single-host slice)."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if hosts:
+        return len([h for h in hosts.split(",") if h.strip()])
+    return 1
+
+
+def get_accelerator_type() -> Optional[str]:
+    """e.g. "v5e", "v5p" — from TPU_ACCELERATOR_TYPE ("v5litepod-16")
+    or the axon gen env."""
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE")
+    if acc:
+        return acc.split("-")[0]
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+    if gen:
+        return gen.split(":")[0]
+    return None
+
+
+def get_num_tpu_chips_on_node() -> int:
+    """Chips visible on this host: explicit env, else jax device count
+    (when jax is already up), else the generation's standard host shape."""
+    env = os.environ.get("RAY_TPU_NUM_TPUS") or os.environ.get("TPU_NUM_DEVICES")
+    if env:
+        return int(env)
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            import jax
+
+            n = sum(1 for d in jax.devices() if d.platform in ("tpu", "axon"))
+            if n:
+                return n
+        except Exception:
+            pass
+    gen = get_accelerator_type()
+    if gen:
+        acc = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        if "-" in acc:
+            # "v5litepod-16" = 16 chips across the pod; per host:
+            total = int(acc.split("-")[-1])
+            return max(1, total // get_current_pod_worker_count())
+        return _CHIPS_PER_HOST.get(gen, 4)
+    return 0

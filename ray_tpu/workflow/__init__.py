@@ -1,0 +1,128 @@
+"""Workflows: durable DAG execution with exactly-once node semantics.
+
+Parity: python/ray/workflow/ (workflow_executor.py + workflow_storage.py)
+— a DAG (the same `fn.bind` graphs ray_tpu.dag builds) runs with every
+node's result checkpointed to storage as it completes; a crashed or
+interrupted workflow resumes by replaying ONLY the nodes without a
+durable result. Storage layout:
+
+    <storage>/<workflow_id>/status.json
+    <storage>/<workflow_id>/results/<node_key>.pkl
+
+Node keys are content-derived (function name + arg structure position in
+the topo order), so resume matches results to nodes deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ..dag.dag_node import DAGNode, FunctionNode, InputNode
+
+_storage_base: Optional[str] = None
+
+
+def init(storage: str) -> None:
+    """Set the workflow storage root (reference: workflow.init)."""
+    global _storage_base
+    _storage_base = os.path.expanduser(storage)
+    os.makedirs(_storage_base, exist_ok=True)
+
+
+def _storage() -> str:
+    if _storage_base is None:
+        raise RuntimeError("call ray_tpu.workflow.init(storage_dir) first")
+    return _storage_base
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage(), workflow_id)
+
+
+def _node_key(node: DAGNode, index: int) -> str:
+    name = ""
+    if isinstance(node, FunctionNode):
+        name = getattr(node._remote_fn, "__name__", "fn")
+    return f"{index:04d}_{name}"
+
+
+def _set_status(workflow_id: str, status: str, **extra) -> None:
+    path = os.path.join(_wf_dir(workflow_id), "status.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dict(extra, status=status), f)
+    os.replace(tmp, path)
+
+
+def get_status(workflow_id: str) -> str:
+    try:
+        with open(os.path.join(_wf_dir(workflow_id), "status.json")) as f:
+            return json.load(f)["status"]
+    except OSError:
+        return "NOT_FOUND"
+
+
+def list_all() -> List[Dict[str, str]]:
+    out = []
+    base = _storage()
+    for wid in sorted(os.listdir(base)):
+        if os.path.isdir(os.path.join(base, wid)):
+            out.append({"workflow_id": wid, "status": get_status(wid)})
+    return out
+
+
+def run(dag: DAGNode, *, workflow_id: str, args: Any = None) -> Any:
+    """Execute (or resume) the DAG durably and return the root's result.
+
+    Every FunctionNode runs as a normal task; its result is fetched and
+    pickled to storage before dependents run (the reference checkpoints
+    through its storage backends the same way). Nodes with durable
+    results are skipped on re-run — crash anywhere, call run() again
+    with the same workflow_id, and only unfinished nodes execute."""
+    import cloudpickle
+
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(ignore_reinit_error=True)
+    wdir = _wf_dir(workflow_id)
+    results_dir = os.path.join(wdir, "results")
+    os.makedirs(results_dir, exist_ok=True)
+    _set_status(workflow_id, "RUNNING")
+
+    schedule = dag._topo()
+    results: Dict[int, Any] = {}
+    try:
+        for index, node in enumerate(schedule):
+            if isinstance(node, InputNode):
+                results[node._id] = args
+                continue
+            if not isinstance(node, FunctionNode):
+                # passthrough nodes (input attributes, multi-output)
+                results[node._id] = node._apply(results, (args,), {})
+                continue
+            key = _node_key(node, index)
+            path = os.path.join(results_dir, key + ".pkl")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    results[node._id] = cloudpickle.load(f)
+                continue
+            ref = node._apply(results, (args,), {})
+            value = ray_tpu.get(ref)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                cloudpickle.dump(value, f)
+            os.replace(tmp, path)  # durable BEFORE dependents may run
+            results[node._id] = value
+    except Exception:
+        _set_status(workflow_id, "FAILED")
+        raise
+    _set_status(workflow_id, "SUCCEEDED")
+    return results[dag._id]
+
+
+def resume(workflow_id: str, dag: DAGNode, *, args: Any = None) -> Any:
+    """Alias of run() — resumption IS re-running with the same id."""
+    return run(dag, workflow_id=workflow_id, args=args)

@@ -1,0 +1,272 @@
+"""Platform services: client connect (Ray Client parity), runtime envs,
+job submission, dashboard HTTP API, util.Queue, config table, memory
+monitor, TPU pod helpers, durable workflows."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+# ---------------------------------------------------------------- client
+def test_client_connect_roundtrip(tmp_path):
+    """A second process connects with init(address=...) and uses the
+    cluster (tasks, actors, big results via object fetch)."""
+    ctx = ray_tpu.init(num_cpus=2, max_workers=2, _tcp_hub=True)
+    addr = ctx.address_info["address"]
+    script = f"""
+import sys; sys.path.insert(0, {json.dumps("/root/repo")})
+import numpy as np
+import ray_tpu
+ray_tpu.init(address={json.dumps(addr)})
+@ray_tpu.remote
+def f(x):
+    return x * 2
+assert ray_tpu.get(f.remote(21)) == 42
+@ray_tpu.remote
+def big():
+    return np.ones(300_000)  # shm on the cluster; fetched by the client
+assert float(ray_tpu.get(big.remote()).sum()) == 300_000.0
+@ray_tpu.remote
+class C:
+    def __init__(self): self.n = 0
+    def inc(self): self.n += 1; return self.n
+c = C.remote()
+assert ray_tpu.get([c.inc.remote() for _ in range(3)]) == [1, 2, 3]
+ray_tpu.shutdown()
+print("CLIENT_OK")
+"""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", script], capture_output=True, text=True,
+            timeout=120,
+        )
+        assert "CLIENT_OK" in out.stdout, out.stderr[-2000:]
+        # the cluster survives the client's exit
+        @ray_tpu.remote
+        def alive():
+            return True
+
+        assert ray_tpu.get(alive.remote(), timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ runtime env
+def test_runtime_env_env_vars(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "hello42"}})
+    def read_flag():
+        return os.environ.get("MY_FLAG")
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_flag.remote(), timeout=60) == "hello42"
+    # plain tasks run on env-less workers (isolation both ways)
+    assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+
+
+def test_runtime_env_working_dir(ray_start_regular, tmp_path):
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "my_module_xyz.py").write_text("VALUE = 'from_working_dir'\n")
+    (pkg / "data.txt").write_text("payload")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+    def use_pkg():
+        import my_module_xyz  # importable: working_dir on sys.path
+
+        with open("data.txt") as f:  # cwd == working_dir
+            return my_module_xyz.VALUE, f.read()
+
+    assert ray_tpu.get(use_pkg.remote(), timeout=60) == (
+        "from_working_dir", "payload",
+    )
+
+
+def test_runtime_env_rejects_unsupported(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"pip": ["requests"]})
+    def f():
+        return 1
+
+    with pytest.raises(ValueError):
+        f.remote()
+
+
+# ------------------------------------------------------------------ jobs
+def test_job_submission_lifecycle(ray_start_regular, tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job ran ok')\"",
+    )
+    status = client.wait_until_finished(job_id, timeout=60)
+    assert status == JobStatus.SUCCEEDED
+    assert "job ran ok" in client.get_job_logs(job_id)
+    assert any(j["job_id"] == job_id for j in client.list_jobs())
+
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finished(bad, timeout=60) == JobStatus.FAILED
+
+
+def test_job_stop(ray_start_regular):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'"
+    )
+    deadline = time.time() + 30
+    while client.get_job_status(job_id) == JobStatus.PENDING:
+        assert time.time() < deadline
+        time.sleep(0.1)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finished(job_id, timeout=30) == JobStatus.STOPPED
+
+
+# -------------------------------------------------------------- dashboard
+def test_dashboard_api(ray_start_regular):
+    import urllib.request
+
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(port=18932).start()
+    try:
+        @ray_tpu.remote
+        def noop():
+            return 1
+
+        ray_tpu.get(noop.remote())
+
+        def get_json(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:18932{path}", timeout=10
+            ) as r:
+                return json.loads(r.read())
+
+        status = get_json("/api/cluster_status")
+        assert status["nodes"][0]["node_id"] == "node0"
+        assert status["resources_total"]["CPU"] == 2.0
+        assert isinstance(get_json("/api/actors"), list)
+        assert any(
+            e.get("state") == "FINISHED" for e in get_json("/api/tasks")
+        )
+        assert isinstance(get_json("/api/timeline"), list)
+        with urllib.request.urlopen(
+            "http://127.0.0.1:18932/metrics", timeout=10
+        ) as r:
+            assert r.status == 200
+    finally:
+        dash.stop()
+
+
+# ------------------------------------------------------------------ queue
+def test_util_queue(ray_start_regular):
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=2)
+    q.put("a")
+    q.put("b")
+    with pytest.raises(Full):
+        q.put("c", block=False)
+    assert q.qsize() == 2 and q.full()
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+# ----------------------------------------------------------------- config
+def test_config_table_env_override(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "12345")
+    from ray_tpu._private import config
+
+    config.reload()
+    assert config.RAY_TPU_CONFIG.memory_usage_threshold == 12345.0
+    assert config.RAY_TPU_CONFIG.inline_object_threshold == 100 * 1024
+    monkeypatch.delenv("RAY_TPU_MEMORY_USAGE_THRESHOLD")
+    config.reload()
+
+
+# --------------------------------------------------------- memory monitor
+def test_memory_monitor_kills_hog(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", str(300 * 1024**2))
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR_PERIOD_S", "0.2")
+    ray_tpu.init(num_cpus=2, max_workers=2)
+    try:
+        from ray_tpu.exceptions import OutOfMemoryError, WorkerCrashedError
+
+        @ray_tpu.remote(max_retries=0)
+        def hog():
+            ballast = bytearray(600 * 1024**2)  # far past the cap
+            time.sleep(20)
+            return len(ballast)
+
+        with pytest.raises((OutOfMemoryError, WorkerCrashedError)):
+            ray_tpu.get(hog.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ tpu helpers
+def test_tpu_pod_helpers(monkeypatch):
+    from ray_tpu.util.accelerators import tpu
+
+    monkeypatch.setenv("TPU_NAME", "my-pod")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h1,h2,h3,h4")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-32")
+    monkeypatch.setenv("RAY_TPU_NUM_TPUS", "8")
+    assert tpu.get_current_pod_name() == "my-pod"
+    assert tpu.get_current_pod_worker_count() == 4
+    assert tpu.get_accelerator_type() == "v5litepod"
+    assert tpu.get_num_tpu_chips_on_node() == 8
+
+
+# -------------------------------------------------------------- workflows
+def test_workflow_durable_resume(ray_start_regular, tmp_path):
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    workflow.init(str(tmp_path / "wf"))
+    calls = tmp_path / "calls"
+    calls.mkdir()
+
+    @ray_tpu.remote
+    def step_a(x):
+        open(calls / "a", "a").write("x")
+        return x + 1
+
+    @ray_tpu.remote
+    def step_b(x):
+        open(calls / "b", "a").write("x")
+        if not os.path.exists(calls / "b_ok"):
+            open(calls / "b_ok", "w").close()
+            raise RuntimeError("transient failure")
+        return x * 10
+
+    with InputNode() as inp:
+        dag = step_b.bind(step_a.bind(inp))
+
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf1", args=4)
+    assert workflow.get_status("wf1") == "FAILED"
+    # resume: step_a's durable result is NOT recomputed
+    out = workflow.run(dag, workflow_id="wf1", args=4)
+    assert out == 50
+    assert workflow.get_status("wf1") == "SUCCEEDED"
+    assert open(calls / "a").read() == "x"      # ran once
+    assert open(calls / "b").read() == "xx"     # failed once, retried once
+    assert {"workflow_id": "wf1", "status": "SUCCEEDED"} in workflow.list_all()
