@@ -1926,6 +1926,17 @@ class Hub:
         elif kind == "objects":
             for oid, e in self.objects.items():
                 items.append({"object_id": oid.hex(), "ready": e.ready, "size": e.size, "kind": e.kind})
+        elif kind == "demand":
+            # pending resource demand by shape (reference: the load the
+            # raylet reports to the GCS for the autoscaler,
+            # autoscaler/v2 ClusterStatus.resource_demands)
+            shapes: Dict[tuple, int] = {}
+            for q in self.runnable.values():
+                for spec in q:
+                    key = tuple(sorted(spec.resources.items()))
+                    shapes[key] = shapes.get(key, 0) + 1
+            for key, count in shapes.items():
+                items.append({"shape": dict(key), "count": count})
         elif kind == "nodes":
             for n in self.nodes.values():
                 items.append(

@@ -576,7 +576,7 @@ class StreamingExecutor:
     def _aggregate(self, refs, key, aggs) -> Iterator[Any]:
         """Hash partition by key + per-partition combine."""
         ray = self._ray()
-        k = max(1, min(len(refs), 8))
+        k = max(1, min(len(refs), self.ctx.shuffle_partitions))
 
         def split_hash(block: Block, k: int) -> List[Block]:
             import zlib
@@ -617,24 +617,30 @@ class StreamingExecutor:
                 yield merge.remote(key, aggs, *parts[i])
 
     def _zip(self, refs: List[Any], other: L.LogicalOp) -> Iterator[Any]:
+        # worker-side merge: the driver only shuffles REFS (r1 Weak
+        # finding: both sides used to materialize in the driver)
         ray = self._ray()
         other_refs = list(StreamingExecutor(build_stages(L.LogicalPlan(other))).execute())
-        left = BlockAccessor.concat([ray.get(r) for r in refs])
-        right = BlockAccessor.concat([ray.get(r) for r in other_refs])
-        la, ra = BlockAccessor.for_block(left), BlockAccessor.for_block(right)
-        if la.num_rows() != ra.num_rows():
-            raise ValueError(
-                f"zip requires equal row counts, got {la.num_rows()} vs {ra.num_rows()}"
-            )
-        if isinstance(left, dict) and isinstance(right, dict):
-            merged = dict(left)
-            for c, v in right.items():
-                merged[c if c not in merged else f"{c}_1"] = v
-            yield ray.put(merged)
-        else:
-            rows = [
+
+        def zip_blocks(n_left: int, *blocks: Block) -> Block:
+            left = BlockAccessor.concat(list(blocks[:n_left]))
+            right = BlockAccessor.concat(list(blocks[n_left:]))
+            la = BlockAccessor.for_block(left)
+            ra = BlockAccessor.for_block(right)
+            if la.num_rows() != ra.num_rows():
+                raise ValueError(
+                    f"zip requires equal row counts, got {la.num_rows()} "
+                    f"vs {ra.num_rows()}"
+                )
+            if isinstance(left, dict) and isinstance(right, dict):
+                merged = dict(left)
+                for c, v in right.items():
+                    merged[c if c not in merged else f"{c}_1"] = v
+                return merged
+            return [
                 {**(lr if isinstance(lr, dict) else {"left": lr}),
                  **(rr if isinstance(rr, dict) else {"right": rr})}
                 for lr, rr in zip(la.iter_rows(), ra.iter_rows())
             ]
-            yield ray.put(rows)
+
+        yield ray.remote(zip_blocks).remote(len(refs), *refs, *other_refs)

@@ -40,6 +40,7 @@ class ServeController:
         self._deployments: Dict[str, DeploymentInfo] = {}
         self._replicas: Dict[str, List[Any]] = {}  # name -> actor handles
         self._replica_versions: Dict[str, List[int]] = {}
+        self._ping_misses: Dict[bytes, int] = {}  # consecutive health misses
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
@@ -118,12 +119,32 @@ class ServeController:
         for name, info in targets.items():
             live = self._replicas.get(name, [])
             versions = self._replica_versions.get(name, [])
-            # drop dead replicas (ping via queue_len)
+            # health checks: ONE parallel ping round per deployment per
+            # reconcile (was one blocking round-trip per replica —
+            # O(replicas) control latency, r1 Weak finding). A slow
+            # replica is only retired after 3 consecutive missed pings
+            # (reference: health_check_failure_threshold).
+            refs = [actor.queue_len.remote() for actor in live]
+            done, _ = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=5.0
+            ) if refs else ([], [])
+            done_set = set(done)
             alive, alive_vers = [], []
-            for actor, ver in zip(live, versions):
-                try:
-                    ray_tpu.get(actor.queue_len.remote(), timeout=5.0)
-                except Exception:
+            for actor, ver, ref in zip(live, versions, refs):
+                rid = actor._actor_id.binary()
+                if ref in done_set:
+                    try:
+                        ray_tpu.get(ref)
+                        healthy = True
+                        self._ping_misses.pop(rid, None)
+                    except Exception:
+                        healthy = False
+                else:
+                    misses = self._ping_misses.get(rid, 0) + 1
+                    self._ping_misses[rid] = misses
+                    healthy = misses < 3
+                if not healthy:
+                    self._ping_misses.pop(rid, None)
                     continue
                 # version bump (redeploy): retire old-code replicas
                 if ver == info.version:

@@ -1,0 +1,69 @@
+"""Autoscaler: scale up on unmet demand, scale down on idle timeout
+(reference: autoscaler/v2/autoscaler.py + fake_multinode provider)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider, NodeTypeConfig
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_scales_up_then_down():
+    c = Cluster(head_num_cpus=1, max_workers=1)
+    provider = LocalNodeProvider(c)
+    scaler = Autoscaler(
+        provider,
+        [NodeTypeConfig("cpu-worker", {"CPU": 2}, min_workers=0, max_workers=3)],
+        poll_interval_s=0.2,
+        upscale_delay_s=0.2,
+        idle_timeout_s=2.0,
+    ).start()
+    try:
+        @ray_tpu.remote(num_cpus=2)  # cannot fit on the 1-CPU head
+        def big_task(i):
+            time.sleep(1.0)
+            import os
+
+            return os.environ.get("RAY_TPU_NODE_ID")
+
+        refs = [big_task.remote(i) for i in range(2)]
+        nodes = ray_tpu.get(refs, timeout=60)  # only possible post-scale-up
+        assert all(n != "node0" for n in nodes)
+        assert len(provider.non_terminated_nodes()) >= 1
+
+        # demand gone: idle nodes retire after the timeout
+        deadline = time.time() + 30
+        while time.time() < deadline and provider.non_terminated_nodes():
+            time.sleep(0.3)
+        assert provider.non_terminated_nodes() == []
+    finally:
+        scaler.stop()
+        c.shutdown()
+
+
+def test_respects_max_workers():
+    c = Cluster(head_num_cpus=1, max_workers=1)
+    provider = LocalNodeProvider(c)
+    scaler = Autoscaler(
+        provider,
+        [NodeTypeConfig("w", {"CPU": 1}, max_workers=2)],
+        poll_interval_s=0.1,
+        upscale_delay_s=0.1,
+        idle_timeout_s=60.0,
+    ).start()
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def hold(i):
+            time.sleep(3)
+            return i
+
+        # far more demand than max_workers allows
+        refs = [hold.remote(i) for i in range(8)]
+        time.sleep(2.0)
+        assert len(provider.non_terminated_nodes()) <= 2
+        ray_tpu.get(refs, timeout=120)
+    finally:
+        scaler.stop()
+        c.shutdown()
