@@ -60,6 +60,9 @@ class CoreClient:
         # client mode (ray_tpu.init(address=...)): no shared shm with the
         # cluster — all puts travel inline through the hub connection
         self.inline_only = False
+        # pubsub: channel -> callback(data); callbacks run on the reader
+        # thread, so keep them light (print/enqueue)
+        self.subscriptions: Dict[str, Any] = {}
         self._closed = False
         self.send(P.HELLO, {"role": role, "worker_id": worker_id,
                             "pid": os.getpid(), "node_id": self.node_id})
@@ -124,6 +127,13 @@ class CoreClient:
                         fut = self._pending.pop(req_id, None)
                     if fut is not None:
                         fut.set_result(payload)
+                elif msg_type == P.PUBSUB_MSG:
+                    cb = self.subscriptions.get(payload["channel"])
+                    if cb is not None:
+                        try:
+                            cb(payload["data"])
+                        except Exception:
+                            pass
                 elif msg_type == P.CANCEL_TASK:
                     # reader-thread fast path: mark before the executor
                     # dequeues it AND resolve the caller immediately —
@@ -453,6 +463,14 @@ class CoreClient:
 
     def cluster_resources(self, available: bool = False) -> dict:
         return self.request(P.CLUSTER_RESOURCES, {"available": available})["resources"]
+
+    def subscribe(self, channel: str, callback) -> None:
+        """Push-based pubsub (reference: GCS pubsub channels)."""
+        self.subscriptions[channel] = callback
+        self.send(P.SUBSCRIBE, {"channel": channel})
+
+    def publish(self, channel: str, data) -> None:
+        self.send_async(P.PUBLISH, {"channel": channel, "data": data})
 
     def close(self) -> None:
         if not self._closed:

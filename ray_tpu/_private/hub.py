@@ -289,6 +289,14 @@ class Hub:
         self._pending_fetches: Dict[int, Tuple[Any, int]] = {}
         self._spawn_wants: Dict[str, int] = {}
         self.streams: Dict[bytes, StreamEntry] = {}
+        self.subscribers: Dict[str, List[Any]] = {}  # channel -> conns
+        # lineage: producer TaskSpec per shm object, for reconstruction
+        # after node loss (reference: task_manager.h lineage pinning +
+        # object_recovery_manager.h:43 re-executing the producing task)
+        self._lineage: Dict[bytes, TaskSpec] = {}
+        self._lineage_order: deque = deque()
+        self._reconstruct_waiters: Dict[bytes, List[Tuple[Any, int]]] = {}
+        self._reconstructing: Set[bytes] = set()
         self._ended_streams: deque = deque()  # consumed stream ids, FIFO
         # observability plane (reference: stats/metric.h registry +
         # core_worker/task_event_buffer.h -> GCS task events)
@@ -489,6 +497,10 @@ class Hub:
         e.node_id = node_id
         if kind == P.VAL_SHM and size > 0:
             self._account_segment(oid, e)
+        self._reconstructing.discard(oid)
+        # serve fetches that were parked on reconstruction
+        for wconn, req_id in self._reconstruct_waiters.pop(oid, []):
+            self._on_fetch_object(wconn, {"object_id": oid, "req_id": req_id})
         # unblock task dependencies
         for spec in self.dep_waiters.pop(oid, []):
             spec.deps_remaining -= 1
@@ -712,6 +724,38 @@ class Hub:
             return
         node = self.nodes.get(e.node_id)
         if node is None or not node.alive:
+            # primary copy died with its node: reconstruct by re-running
+            # the producing task (reference: ObjectRecoveryManager)
+            spec = self._lineage.get(p["object_id"])
+            if spec is not None:
+                oid = p["object_id"]
+                self._reconstruct_waiters.setdefault(oid, []).append(
+                    (conn, p["req_id"])
+                )
+
+                def give_up(oid=oid):
+                    # rerun unplaceable (resources gone) or stuck: fail
+                    # the parked fetches instead of hanging them forever
+                    for wconn, req_id in self._reconstruct_waiters.pop(oid, []):
+                        self._reply(wconn, req_id, data=None,
+                                    error="object lost: reconstruction "
+                                          "timed out")
+                    self._reconstructing.discard(oid)
+
+                self._add_timer(60.0, give_up)
+                if p["object_id"] not in self._reconstructing:
+                    self._reconstructing.update(spec.return_ids)
+                    for roid in spec.return_ids:
+                        entry = self.objects.get(roid)
+                        if entry is not None:
+                            self._drop_segment_accounting(roid, entry)
+                            entry.ready = False
+                            entry.spilled = False
+                    spec.retries_left = max(spec.retries_left, 1)
+                    spec.options.pop("_pool", None)
+                    self.tasks[spec.task_id] = spec
+                    self._enqueue_runnable(spec)
+                return
             self._reply(conn, p["req_id"], data=None,
                         error=f"object lost: node {e.node_id} is gone")
             return
@@ -901,6 +945,28 @@ class Hub:
                 for k in list(self._task_event_index)[:drop]:
                     del self._task_event_index[k]
         ev.update(fields)
+
+    # ----- pubsub (reference: src/ray/pubsub/publisher.h:300 — here a
+    # direct push over the subscriber's persistent connection)
+    def _on_subscribe(self, conn, p):
+        subs = self.subscribers.setdefault(p["channel"], [])
+        if conn not in subs:
+            subs.append(conn)
+
+    def _on_publish(self, conn, p):
+        self._publish(p["channel"], p["data"])
+
+    def _publish(self, channel: str, data) -> None:
+        # dead conns are pruned by _handle_disconnect; _send tolerates
+        # races with a closing socket
+        for sub in self.subscribers.get(channel, ()):
+            self._send(sub, P.PUBSUB_MSG, {"channel": channel, "data": data})
+
+    def _on_log_record(self, conn, p):
+        # worker stdout/stderr lines fan out to log subscribers (the
+        # reference's log_monitor -> driver pattern)
+        wid = self.conn_to_worker.get(conn, "?")
+        self._publish("__logs__", dict(p, worker_id=wid))
 
     # ----- functions
     def _on_register_function(self, conn, p):
@@ -1335,6 +1401,14 @@ class Hub:
             if actor is not None:
                 actor.inflight.pop(p["task_id"], None)
         node_id = worker.node_id if worker is not None else "node0"
+        if spec is not None and spec.actor_id is None and not spec.is_actor_create:
+            for oid, kind, _, _ in p["returns"]:
+                if kind == P.VAL_SHM:
+                    if oid not in self._lineage:
+                        self._lineage_order.append(oid)
+                        while len(self._lineage_order) > 10000:
+                            self._lineage.pop(self._lineage_order.popleft(), None)
+                    self._lineage[oid] = spec
         prev_ev = self._task_event_index.get(p["task_id"], {})
         failed = (
             any(kind == P.VAL_ERROR for _, kind, _, _ in p["returns"])
@@ -1578,6 +1652,9 @@ class Hub:
     def _handle_disconnect(self, conn):
         if conn in self.client_conns:
             self.client_conns.remove(conn)
+        for subs in self.subscribers.values():
+            if conn in subs:
+                subs.remove(conn)
         node_id = self.agent_conns.pop(conn, None)
         if node_id is not None:
             self._node_died(node_id)

@@ -65,6 +65,13 @@ EXEC_ACTOR_TASK = "exec_actor_task"
 KILL = "kill"
 CANCEL_TASK = "cancel_task"  # hub -> worker: drop a queued task
 
+# pubsub (reference: src/ray/pubsub/ long-poll publisher; here
+# subscribers hold persistent conns so publish is a direct push)
+SUBSCRIBE = "subscribe"      # client -> hub: {channel}
+PUBLISH = "publish"          # client -> hub -> subscribers: {channel, data}
+PUBSUB_MSG = "pubsub_msg"    # hub -> subscriber push
+LOG_RECORD = "log_record"    # worker -> hub: stdout/stderr line batch
+
 # hub -> client
 REPLY = "reply"
 

@@ -103,6 +103,8 @@ def init(
                 worker_id=f"client_{os.getpid()}",
             )
             _client.inline_only = True  # no shared /dev/shm with the cluster
+            if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+                _subscribe_worker_logs(_client)
             atexit.register(shutdown)
             return RuntimeContext()
 
@@ -138,8 +140,23 @@ def init(
         )
         _hub.start()
         _client = CoreClient(_hub.addr, _session_dir, role="driver", worker_id="driver")
+        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            _subscribe_worker_logs(_client)
         atexit.register(shutdown)
         return RuntimeContext()
+
+
+def _subscribe_worker_logs(client: CoreClient) -> None:
+    """Print worker stdout/stderr on the driver with a worker prefix
+    (reference: the (fn pid=...) lines ray drivers show)."""
+    import sys as _sys
+
+    def on_log(rec):
+        stream = _sys.stderr if rec.get("stream") == "stderr" else _sys.stdout
+        for line in rec.get("lines", []):
+            print(f"(worker pid={rec.get('pid')}) {line}", file=stream)
+
+    client.subscribe("__logs__", on_log)
 
 
 def shutdown() -> None:

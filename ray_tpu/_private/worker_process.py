@@ -351,6 +351,52 @@ def _setup_runtime_env(client, session_dir: str) -> None:
         sys.path.insert(0, target)
 
 
+class _LogTee:
+    """Mirror worker stdout/stderr to the driver (reference: worker log
+    redirection + log_monitor.py streaming to the driver). Lines batch
+    through the existing hub connection; the original stream still gets
+    everything (container logs)."""
+
+    def __init__(self, client, orig, stream_name: str):
+        self._client = client
+        self._orig = orig
+        self._name = stream_name
+        self._buf = ""
+        self._lock = threading.Lock()
+
+    def _emit(self, lines):
+        lines = [l for l in lines if l.strip()]
+        if lines:
+            try:
+                self._client.send_async(
+                    P.LOG_RECORD,
+                    {"stream": self._name, "lines": lines,
+                     "pid": os.getpid()},
+                )
+            except Exception:
+                pass
+
+    def write(self, s):
+        self._orig.write(s)
+        with self._lock:  # concurrent print()s must not corrupt the buffer
+            self._buf += s
+            if "\n" not in self._buf:
+                return len(s)
+            *lines, self._buf = self._buf.split("\n")
+        self._emit(lines)
+        return len(s)
+
+    def flush(self):
+        self._orig.flush()
+        with self._lock:
+            tail, self._buf = self._buf, ""
+        if tail:
+            self._emit([tail])
+
+    def __getattr__(self, name):
+        return getattr(self._orig, name)
+
+
 def main():
     sys.setswitchinterval(0.001)
     hub_addr = os.environ["RAY_TPU_HUB_ADDR"]
@@ -358,6 +404,9 @@ def main():
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
     client = CoreClient(hub_addr, session_dir, role="worker", worker_id=worker_id)
     _setup_runtime_env(client, session_dir)
+    if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+        sys.stdout = _LogTee(client, sys.stdout, "stdout")
+        sys.stderr = _LogTee(client, sys.stderr, "stderr")
 
     # make ray_tpu.* API work inside tasks (auto-connect)
     from . import worker as worker_mod

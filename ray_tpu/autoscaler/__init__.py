@@ -92,6 +92,9 @@ class Autoscaler:
         self._demand_since: Optional[float] = None
         self._idle_since: Dict[str, float] = {}
         self._owned_type: Dict[str, NodeTypeConfig] = {}
+        self._launched_at: Dict[str, float] = {}
+        self.launch_grace_s = 30.0  # registration time before a missing
+        # node counts as dead (out-of-band failure)
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
@@ -139,11 +142,26 @@ class Autoscaler:
                 # one node per unmet shape per pass (launch pacing)
                 node_id = self.provider.create_node(nt)
                 self._owned_type[node_id] = nt
+                self._launched_at[node_id] = time.monotonic()
                 counts[nt.name] = counts.get(nt.name, 0) + 1
                 break
 
     def _maybe_scale_down(self, avail_nodes, client) -> None:
         now = time.monotonic()
+        # nodes that died out-of-band must release their max_workers
+        # budget (and provider bookkeeping) or that type can never scale;
+        # a launch grace period keeps this from racing registration
+        for node_id in list(self._owned_type):
+            if node_id not in avail_nodes and (
+                now - self._launched_at.get(node_id, now) > self.launch_grace_s
+            ):
+                try:
+                    self.provider.terminate_node(node_id)
+                except Exception:
+                    pass
+                self._owned_type.pop(node_id, None)
+                self._idle_since.pop(node_id, None)
+                self._launched_at.pop(node_id, None)
         busy_nodes = {
             w["node_id"]
             for w in client.list_state("workers")
@@ -179,11 +197,19 @@ class Autoscaler:
         self._running = True
 
         def loop():
+            import sys
+            import traceback
+
             while self._running:
                 try:
                     self.step()
                 except Exception:
-                    pass  # transient control-plane hiccups don't kill scaling
+                    # transient control-plane hiccups must not kill the
+                    # loop, but they must be visible
+                    sys.stderr.write(
+                        f"[ray_tpu] autoscaler step failed:\n"
+                        f"{traceback.format_exc()}\n"
+                    )
                 time.sleep(self.poll_interval_s)
 
         self._thread = threading.Thread(

@@ -174,6 +174,16 @@ class ServeController:
             for name in list(self._replicas):
                 if name not in targets:
                     self._scale_to(name, None, 0)
+            # miss counters only for replicas that still exist (retired
+            # generations would otherwise leak entries forever)
+            live_rids = {
+                a._actor_id.binary()
+                for actors in self._replicas.values()
+                for a in actors
+            }
+            for rid in list(self._ping_misses):
+                if rid not in live_rids:
+                    del self._ping_misses[rid]
 
     def _start_replica(self, info: DeploymentInfo):
         import ray_tpu

@@ -145,3 +145,24 @@ def test_node_death_detected(cluster):
     assert entry and not entry[0]["alive"]
     total = ray_tpu.cluster_resources()
     assert "dying" not in total
+
+
+def test_lineage_reconstruction_after_node_death(cluster):
+    """An object whose only copy died with its node is reconstructed by
+    re-running the producing task (reference: object_recovery_manager.h
+    re-execution path), transparently inside ray_tpu.get."""
+    node = cluster.add_node(num_cpus=2, resources={"ephemeral": 4.0})
+
+    @ray_tpu.remote(resources={"ephemeral": 1.0}, max_retries=2)
+    def make():
+        return np.arange(250_000, dtype=np.float64)  # shm segment
+
+    ref = make.remote()
+    # materialize on the doomed node (do NOT fetch to the driver yet)
+    ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert ready
+    cluster.remove_node(node)
+    # resources for the rerun must exist: revive the custom resource
+    cluster.add_node(num_cpus=2, resources={"ephemeral": 4.0})
+    arr = ray_tpu.get(ref, timeout=60)  # fetch fails -> reconstructs
+    assert float(arr[123_456]) == 123_456.0

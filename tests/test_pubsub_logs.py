@@ -1,0 +1,45 @@
+"""Pubsub channels + worker log streaming to the driver (reference:
+src/ray/pubsub/ + log_monitor.py driver log forwarding)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_pubsub_roundtrip(ray_start_regular):
+    client = ray_tpu._private.worker.get_client()
+    got = []
+    client.subscribe("my_channel", got.append)
+
+    @ray_tpu.remote
+    def publisher():
+        c = ray_tpu._private.worker.get_client()
+        for i in range(3):
+            c.publish("my_channel", {"i": i})
+        c.flush()
+        return True
+
+    assert ray_tpu.get(publisher.remote(), timeout=30)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(got) < 3:
+        time.sleep(0.05)
+    assert [m["i"] for m in got] == [0, 1, 2]
+
+
+def test_worker_prints_reach_driver(ray_start_regular, capsys):
+    @ray_tpu.remote
+    def chatty():
+        print("hello from the worker side")
+        return 1
+
+    assert ray_tpu.get(chatty.remote(), timeout=30) == 1
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        out = capsys.readouterr().out
+        if "hello from the worker side" in out:
+            assert "(worker pid=" in out
+            return
+        time.sleep(0.1)
+    pytest.fail("worker stdout never reached the driver")
