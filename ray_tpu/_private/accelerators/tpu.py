@@ -1,0 +1,63 @@
+"""TPUAcceleratorManager (reference:
+python/ray/_private/accelerators/tpu.py:109).
+
+Detection is env-first (TPU VM standard vars + this runtime's knobs +
+live jax when already imported); the reference's GCE-metadata fallback
+needs egress air-gapped pods don't have. Emits the same resource shape:
+``TPU`` chips, ``TPU-<accelerator_type>`` (:352) and the per-pod name
+resource ``TPU-<pod>-head`` style gang-affinity key (:375).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from .accelerator import AcceleratorManager
+
+
+class TPUAcceleratorManager(AcceleratorManager):
+    @staticmethod
+    def get_resource_name() -> str:
+        return "TPU"
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> str:
+        return "TPU_VISIBLE_CHIPS"
+
+    @staticmethod
+    def get_current_node_num_accelerators() -> int:
+        from ray_tpu.util.accelerators import tpu as helpers
+
+        return helpers.get_num_tpu_chips_on_node()
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        acc = os.environ.get("TPU_ACCELERATOR_TYPE")
+        if acc:
+            return f"TPU-{acc}"
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN")
+        if gen:
+            return f"TPU-{gen.split(':')[0]}"
+        return None
+
+    @staticmethod
+    def get_current_node_additional_resources() -> Dict[str, float]:
+        from ray_tpu.util.accelerators import tpu as helpers
+
+        pod = helpers.get_current_pod_name()
+        if pod:
+            # pod-name resource: schedule a gang onto one specific pod
+            # (reference tpu.py:375 TPU-{name} affinity resource)
+            return {f"TPU-{pod}": 1.0}
+        return {}
+
+    @staticmethod
+    def validate_resource_request_quantity(quantity: float):
+        if quantity != int(quantity):
+            return (False, "TPU chip requests must be whole chips")
+        return (True, None)
+
+    @staticmethod
+    def set_current_process_visible_accelerators(ids: List[str]) -> None:
+        os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in ids)

@@ -114,6 +114,14 @@ def init(
         ncpu = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
         ntpu = num_tpus if num_tpus is not None else _detect_num_tpus()
         res: Dict[str, float] = {"CPU": float(ncpu)}
+        # accelerator-manager detection (reference: node resources built
+        # from AcceleratorManager plugins) — explicit args still win
+        from .accelerators import detect_resources
+
+        detected = detect_resources()
+        if num_tpus is not None:
+            detected.pop("TPU", None)
+        res.update(detected)
         if ntpu:
             res["TPU"] = float(ntpu)
         if num_gpus:

@@ -34,6 +34,12 @@ class ScalingConfig:
     placement_strategy: str = "PACK"
     topology: Optional[str] = None
     trainer_resources: Optional[Dict[str, float]] = None
+    # elastic training (reference: train/v2 ScalingPolicy + elastic
+    # resize): when set, a gang that cannot be placed at num_workers
+    # after a failure restarts at a smaller size (halving down to this
+    # floor) instead of failing the run.
+    min_workers: Optional[int] = None
+    placement_timeout_s: float = 60.0
 
     def _resources_per_worker_not_none(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

@@ -19,6 +19,11 @@ from typing import Any, Callable, Dict, List, Optional
 from ...air.config import ScalingConfig
 
 
+class GangUnschedulableError(RuntimeError):
+    """The worker gang cannot currently be placed (elastic trainers
+    react by shrinking; reference: v2 scaling_policy resize decisions)."""
+
+
 class TrainWorker:
     """Actor hosting one training process's session + train_fn thread."""
 
@@ -175,8 +180,11 @@ class WorkerGroup:
             sc._resources_per_worker_not_none() for _ in range(sc.num_workers)
         ]
         self._pg = placement_group(bundles, strategy=sc.placement_strategy)
-        if not self._pg.wait(timeout_seconds=60.0):
-            raise RuntimeError(
+        if not self._pg.wait(timeout_seconds=sc.placement_timeout_s):
+            from ...util.placement_group import remove_placement_group
+
+            remove_placement_group(self._pg)
+            raise GangUnschedulableError(
                 f"placement group for {sc.num_workers} train workers "
                 f"({bundles[0]} each) not schedulable on this cluster"
             )

@@ -90,11 +90,18 @@ class DataParallelTrainer:
         self._next_iteration = 0
         error: Optional[Exception] = None
 
+        from ._internal.worker_group import GangUnschedulableError
+
+        sc = self.scaling_config
+        current_workers = sc.num_workers
         while True:
             try:
-                self._run_attempt(name, storage_dir, ckpt_mgr, latest_ckpt)
+                self._run_attempt(
+                    name, storage_dir, ckpt_mgr, latest_ckpt,
+                    num_workers=current_workers,
+                )
                 break
-            except TrainingFailedError as e:
+            except (TrainingFailedError, GangUnschedulableError) as e:
                 failures += 1
                 latest_ckpt = ckpt_mgr.latest_checkpoint or latest_ckpt
                 allowed = (
@@ -102,9 +109,21 @@ class DataParallelTrainer:
                     or failures <= failure_config.max_failures
                 )
                 if failure_config.fail_fast or not allowed:
-                    error = e
+                    error = (
+                        e if isinstance(e, TrainingFailedError)
+                        else TrainingFailedError(str(e))
+                    )
                     break
-                # else: elastic restart from the latest checkpoint
+                if (
+                    isinstance(e, GangUnschedulableError)
+                    and sc.min_workers
+                    and current_workers > sc.min_workers
+                ):
+                    # elastic resize (reference: v2 ScalingPolicy): the
+                    # full gang no longer fits — halve toward the floor
+                    # and resume from the latest checkpoint
+                    current_workers = max(sc.min_workers, current_workers // 2)
+                # else: gang restart at the same size from the checkpoint
 
         checkpoint = ckpt_mgr.latest_checkpoint
         return Result(
@@ -122,11 +141,17 @@ class DataParallelTrainer:
         storage_dir: str,
         ckpt_mgr: CheckpointManager,
         latest_ckpt: Optional[Checkpoint],
+        num_workers: Optional[int] = None,
     ) -> None:
         import ray_tpu
         from ..exceptions import ActorError, TaskError
 
-        wg = WorkerGroup(self.scaling_config, name)
+        sc = self.scaling_config
+        if num_workers is not None and num_workers != sc.num_workers:
+            import dataclasses
+
+            sc = dataclasses.replace(sc, num_workers=num_workers)
+        wg = WorkerGroup(sc, name)
         backend: Backend = self.backend_config.backend_cls()
         try:
             wg.start()

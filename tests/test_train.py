@@ -215,3 +215,35 @@ def test_dataset_shard_passthrough(ray_start_4_cpus, storage):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["n"] == 5
+
+
+def test_elastic_resize_on_unschedulable_gang(ray_start_4_cpus, tmp_path):
+    """Elastic training (reference: train/v2 ScalingPolicy): a gang that
+    cannot be placed at full size restarts at a smaller size bounded by
+    min_workers instead of failing."""
+    from ray_tpu.train import RunConfig
+    from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+    from ray_tpu.air.config import FailureConfig, ScalingConfig
+
+    def loop(config):
+        from ray_tpu.train import session
+
+        session.report({"world": session.get_context().get_world_size()})
+
+    trainer = DataParallelTrainer(
+        loop,
+        # 8 x 1-CPU workers can never fit on 4 CPUs: must shrink 8->4
+        scaling_config=ScalingConfig(
+            num_workers=8,
+            resources_per_worker={"CPU": 1},
+            min_workers=2,
+            placement_timeout_s=2.0,
+        ),
+        run_config=RunConfig(
+            name="elastic", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=3),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["world"] == 4  # halved once: 8 -> 4 fits
