@@ -6,13 +6,20 @@ PPO (sync batch) + IMPALA (async actor-learner with V-trace, §2.5).
 """
 
 from .algorithm import Algorithm
+from .bc import BC, BCConfig
 from .core import MLPSpec, forward, init_mlp_module, sample_actions
 from .env_runner import SingleAgentEnvRunner
+from .dqn import DQN, DQNConfig
 from .impala import IMPALA, IMPALAConfig, vtrace
+from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .ppo import PPOConfig
 
 __all__ = [
     "Algorithm",
+    "BC",
+    "BCConfig",
+    "DQN",
+    "DQNConfig",
     "IMPALA",
     "IMPALAConfig",
     "MLPSpec",
@@ -22,4 +29,6 @@ __all__ = [
     "init_mlp_module",
     "sample_actions",
     "vtrace",
+    "ReplayBuffer",
+    "PrioritizedReplayBuffer",
 ]

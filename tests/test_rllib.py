@@ -137,3 +137,91 @@ def test_vtrace_reduces_to_gae_like_onpolicy():
         expected[t] = rewards[t] + 0.9 * nxt
         nxt = expected[t]
     np.testing.assert_allclose(np.asarray(vs), expected, rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------------ DQN
+def test_dqn_learns_cartpole(ray_start_4_cpus):
+    """Off-policy replay + target-network convergence regression
+    (reference: dqn tuned_examples bar)."""
+    from ray_tpu.rllib import DQNConfig
+
+    a = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .training(lr=5e-4, updates_per_iteration=16, train_intensity=8,
+                  num_steps_sampled_before_learning_starts=500,
+                  epsilon_decay_steps=6000, target_network_update_freq=100)
+        .debugging(seed=7)
+        .build_algo()
+    )
+    try:
+        first = last = None
+        for _ in range(24):
+            r = a.train()
+            if first is None and r["num_episodes"] > 0:
+                first = r["episode_return_mean"]
+            if r["num_episodes"] > 0:
+                last = r["episode_return_mean"]
+        assert first is not None and last is not None
+        assert last > first + 20, (first, last)
+        assert a.compute_single_action([0.0, 0.0, 0.0, 0.0]) in (0, 1)
+    finally:
+        a.stop()
+
+
+def test_replay_buffer_ring_and_sampling():
+    import numpy as np
+
+    from ray_tpu.rllib import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=100, seed=0)
+    buf.add({"x": np.arange(60, dtype=np.int64)})
+    assert len(buf) == 60
+    buf.add({"x": np.arange(60, 130, dtype=np.int64)})  # wraps: keeps last 100
+    assert len(buf) == 100
+    sample = buf.sample(500)["x"]
+    # oldest 30 entries were overwritten by the ring
+    assert sample.min() >= 30 and sample.max() <= 129
+
+
+def test_prioritized_replay_prefers_high_td():
+    import numpy as np
+
+    from ray_tpu.rllib import PrioritizedReplayBuffer
+
+    buf = PrioritizedReplayBuffer(capacity=64, alpha=1.0, seed=0)
+    buf.add({"x": np.arange(64, dtype=np.int64)})
+    s = buf.sample(32)
+    td = np.where(s["x"] == s["x"][0], 10.0, 0.0)  # one item very surprising
+    buf.update_priorities(td)
+    hot = int(s["x"][0])
+    counts = sum(
+        int((buf.sample(64)["x"] == hot).sum()) for _ in range(20)
+    )
+    # p(hot) ~ 10/(10 + ~32 unsampled at prio 1.0) ~ 0.24 of 1280 draws;
+    # uniform would give ~20 — prioritization must dominate clearly
+    assert counts > 150, counts
+    assert "weights" in s and s["weights"].max() <= 1.0
+
+
+def test_bc_offline_from_dataset(ray_start_4_cpus):
+    """Offline path: behavior cloning from a ray_tpu.data Dataset
+    (reference: rllib/algorithms/bc + rllib/offline over Ray Data)."""
+    import ray_tpu.data as rdata
+    from ray_tpu.rllib import BCConfig
+
+    # expert policy: action = 1 iff obs[0] + obs[1] > 0
+    rng = np.random.default_rng(0)
+    obs = rng.normal(size=(2000, 4)).astype(np.float32)
+    actions = (obs[:, 0] + obs[:, 1] > 0).astype(np.int64)
+    ds = rdata.from_items(
+        [{"obs": o, "actions": a} for o, a in zip(obs, actions)]
+    )
+    algo = BCConfig().training(lr=3e-3).build_algo(obs_dim=4, num_actions=2)
+    result = algo.train_on_dataset(ds, epochs=25)
+    assert result["num_samples_trained"] == 25 * 2000
+    assert result["loss"] < 0.25
+    test_obs = rng.normal(size=(200, 4)).astype(np.float32)
+    preds = np.array([algo.compute_single_action(o) for o in test_obs])
+    truth = (test_obs[:, 0] + test_obs[:, 1] > 0).astype(np.int64)
+    assert (preds == truth).mean() > 0.9
