@@ -181,7 +181,7 @@ class CoreClient:
 
     def request(self, msg_type: str, payload: dict, timeout: Optional[float] = None) -> dict:
         import time as _time
-        from concurrent.futures import TimeoutError as _FutTimeout
+        from concurrent.futures import wait as _fut_wait
 
         req_id = next(self._req_counter)
         fut: Future = Future()
@@ -201,14 +201,18 @@ class CoreClient:
                 remaining = min(remaining, deadline - _time.monotonic())
                 if remaining <= 0:
                     raise TimeoutError(f"{msg_type} request timed out")
-            try:
-                return fut.result(timeout=remaining)
-            except _FutTimeout:
-                if self._closed:
-                    raise ConnectionError("hub connection lost") from None
-                # reply lost or hub slow: retransmit the same req_id (a
-                # duplicate reply finds no pending future and is dropped)
-                self.send(msg_type, payload)
+            # Non-raising wait: chunk expiry must be distinguishable from
+            # an EXTERNAL TimeoutError (e.g. a test-harness SIGALRM) —
+            # concurrent.futures.TimeoutError IS builtins.TimeoutError, so
+            # an except here would swallow cancellation and spin forever.
+            _fut_wait([fut], timeout=remaining)
+            if fut.done():
+                return fut.result()
+            if self._closed:
+                raise ConnectionError("hub connection lost")
+            # reply lost or hub slow: retransmit the same req_id (a
+            # duplicate reply finds no pending future and is dropped)
+            self.send(msg_type, payload)
 
     # --------------------------------------------------------------- objects
     def put_value(self, obj: Any, object_id: Optional[ObjectID] = None) -> ObjectID:

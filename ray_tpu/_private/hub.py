@@ -417,7 +417,18 @@ class Hub:
         return out
 
     def _node_worker_count(self, node_id: str) -> int:
-        return sum(1 for w in self.workers.values() if w.node_id == node_id)
+        """Workers counted against the node's POOLED task-worker cap —
+        actor-bound workers don't count (actors always get processes;
+        the reference likewise grows its pool for actors rather than
+        letting pinned actors starve task execution)."""
+        return sum(
+            1 for w in self.workers.values()
+            if w.node_id == node_id
+            and w.actor_id is None
+            and not (
+                w.current_task is not None and w.current_task.is_actor_create
+            )
+        )
 
     def _on_hello(self, conn, p):
         if p["role"] == "worker":
@@ -1133,18 +1144,30 @@ class Hub:
         for key in empty_keys:
             if not self.runnable.get(key):
                 self.runnable.pop(key, None)
-        # spawn workers where placement deferred for lack of an idle worker
+        # spawn workers where placement deferred for lack of an idle
+        # worker. max_workers caps the POOLED task-worker count; actor
+        # creations always get a process (actors pin workers for life —
+        # capping them would deadlock gangs larger than the pool, where
+        # the reference just grows its worker pool).
         for node_id, wants in self._spawn_wants.items():
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
                 continue
-            can = min(
-                len(wants) - node.spawning,
-                node.max_workers - self._node_worker_count(node_id),
+            budget = max(
+                0,
+                min(
+                    len(wants) - node.spawning,
+                    node.max_workers - self._node_worker_count(node_id),
+                ),
             )
-            for renv, renv_hash in wants[:max(0, can)]:
-                self._spawn_worker(node, runtime_env=renv,
-                                   renv_hash=renv_hash)
+            for renv, renv_hash, is_actor in wants:
+                if is_actor:
+                    self._spawn_worker(node, runtime_env=renv,
+                                       renv_hash=renv_hash)
+                elif budget > 0:
+                    budget -= 1
+                    self._spawn_worker(node, runtime_env=renv,
+                                       renv_hash=renv_hash)
 
     def _try_place(self, spec: TaskSpec) -> str:
         pools = self._effective_pools(spec)
@@ -1200,7 +1223,8 @@ class Hub:
             if n_chips == 0 or len(node.free_tpu_chips) >= n_chips:
                 self._spawn_wants.setdefault(node.node_id, []).append(
                     (spec.options.get("runtime_env"),
-                     spec.options.get("runtime_env_hash", ""))
+                     spec.options.get("runtime_env_hash", ""),
+                     spec.is_actor_create)
                 )
                 self._last_spawn_node = node.node_id
                 self._last_spawn_env = self._spawn_wants[node.node_id][-1]

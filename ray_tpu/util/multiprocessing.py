@@ -1,0 +1,129 @@
+"""multiprocessing.Pool drop-in over the distributed runtime.
+
+Parity: python/ray/util/multiprocessing/ — the Pool API (map/starmap/
+apply/async variants/imap) executing on cluster workers instead of local
+forks, so existing Pool code scales past one host unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        import ray_tpu
+
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        done, _ = ray_tpu.wait(
+            self._refs, num_returns=len(self._refs), timeout=0
+        )
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Process pool on cluster workers (reference: util/multiprocessing).
+
+    processes bounds in-flight task parallelism (the runtime's worker
+    pool does the real scaling)."""
+
+    def __init__(self, processes: Optional[int] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self._ray = ray_tpu
+        self._processes = processes
+        self._closed = False
+
+    # -- sync ----------------------------------------------------------
+    def map(self, func: Callable, iterable: Iterable) -> List[Any]:
+        return self.map_async(func, iterable).get()
+
+    def starmap(self, func: Callable, iterable: Iterable) -> List[Any]:
+        return self.starmap_async(func, iterable).get()
+
+    def apply(self, func: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(func, args, kwds).get()
+
+    # -- async ---------------------------------------------------------
+    def _remote(self, func):
+        import ray_tpu
+
+        return ray_tpu.remote(func)
+
+    def map_async(self, func: Callable, iterable: Iterable) -> AsyncResult:
+        self._check_open()
+        rf = self._remote(func)
+        return AsyncResult([rf.remote(x) for x in iterable], single=False)
+
+    def starmap_async(self, func: Callable, iterable: Iterable) -> AsyncResult:
+        self._check_open()
+        rf = self._remote(func)
+        return AsyncResult([rf.remote(*x) for x in iterable], single=False)
+
+    def apply_async(self, func: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        self._check_open()
+        rf = self._remote(func)
+        return AsyncResult([rf.remote(*args, **(kwds or {}))], single=True)
+
+    def imap(self, func: Callable, iterable: Iterable):
+        self._check_open()
+        rf = self._remote(func)
+        refs = [rf.remote(x) for x in iterable]
+        for ref in refs:
+            yield self._ray.get(ref)
+
+    def imap_unordered(self, func: Callable, iterable: Iterable):
+        self._check_open()
+        rf = self._remote(func)
+        pending = {rf.remote(x) for x in iterable}
+        while pending:
+            done, rest = self._ray.wait(
+                list(pending), num_returns=1, timeout=60
+            )
+            for ref in done:
+                pending.discard(ref)
+                yield self._ray.get(ref)
+
+    # -- lifecycle ------------------------------------------------------
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -1,0 +1,87 @@
+"""State API: list + summarize cluster entities.
+
+Parity: python/ray/util/state/api.py (:784 list_*, :1359-1425
+summarize_*) over the hub's live tables instead of a dashboard
+aggregator head.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+
+def _client():
+    from ray_tpu._private import worker
+
+    return worker.get_client()
+
+
+def list_actors(filters: Optional[list] = None) -> List[dict]:
+    return _apply_filters(_client().list_state("actors"), filters)
+
+def list_tasks(filters: Optional[list] = None) -> List[dict]:
+    return _apply_filters(_client().list_state("tasks"), filters)
+
+def list_workers(filters: Optional[list] = None) -> List[dict]:
+    return _apply_filters(_client().list_state("workers"), filters)
+
+def list_nodes(filters: Optional[list] = None) -> List[dict]:
+    return _apply_filters(_client().list_state("nodes"), filters)
+
+def list_objects(filters: Optional[list] = None) -> List[dict]:
+    return _apply_filters(_client().list_state("objects"), filters)
+
+def list_placement_groups(filters: Optional[list] = None) -> List[dict]:
+    return _apply_filters(_client().list_state("placement_groups"), filters)
+
+
+def _apply_filters(items: List[dict], filters: Optional[list]) -> List[dict]:
+    """filters: [(key, "=" | "!=", value), ...] (reference filter shape)."""
+    if not filters:
+        return items
+    out = []
+    for item in items:
+        ok = True
+        for key, op, value in filters:
+            got = item.get(key)
+            if op == "=" and got != value:
+                ok = False
+            elif op == "!=" and got == value:
+                ok = False
+        if ok:
+            out.append(item)
+    return out
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Counts by state and by function (reference: summarize_tasks)."""
+    events = _client().list_state("tasks")
+    by_state = Counter(e.get("state", "UNKNOWN") for e in events)
+    by_func: Dict[str, Counter] = {}
+    for e in events:
+        name = (e.get("name") or "unknown").split(":")[0]
+        by_func.setdefault(name, Counter())[e.get("state", "UNKNOWN")] += 1
+    return {
+        "total": len(events),
+        "by_state": dict(by_state),
+        "by_func_name": {k: dict(v) for k, v in by_func.items()},
+    }
+
+
+def summarize_actors() -> Dict[str, Any]:
+    actors = _client().list_state("actors")
+    return {
+        "total": len(actors),
+        "by_state": dict(Counter(a["state"] for a in actors)),
+    }
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objects = _client().list_state("objects")
+    ready = [o for o in objects if o.get("ready")]
+    return {
+        "total": len(objects),
+        "ready": len(ready),
+        "total_size_bytes": sum(o.get("size", 0) for o in ready),
+    }

@@ -19,6 +19,12 @@ import os
 # virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Persistent compilation cache shared by the test process AND every
+# spawned worker process (env inherits): each worker would otherwise
+# re-jit identical tiny programs, which dominates suite wall time on
+# this 1-core box.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ray_tpu_jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"

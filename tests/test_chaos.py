@@ -34,7 +34,7 @@ def test_get_survives_drops(chaos_runtime):
         return i * 2
 
     # many gets: with p=0.4 drop per request, ~40% need >=1 retransmit
-    for batch in range(5):
+    for batch in range(3):
         refs = [f.remote(i) for i in range(10)]
         assert ray_tpu.get(refs, timeout=60) == [i * 2 for i in range(10)]
 

@@ -98,8 +98,12 @@ def test_placement_bundles_tp_pp():
     assert bundles == [{"TPU": 4.0, "CPU": 1.0}] * 2
 
 
-@pytest.fixture
-def serve_llm(ray_start_4_cpus, tmp_path, engine_setup):
+@pytest.fixture(scope="module")
+def serve_llm(engine_setup, tmp_path_factory):
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, max_workers=4, ignore_reinit_error=True)
+    tmp_path = tmp_path_factory.mktemp("llmserve")
     from ray_tpu import serve
 
     cfg, params = engine_setup
@@ -116,6 +120,7 @@ def serve_llm(ray_start_4_cpus, tmp_path, engine_setup):
     )
     yield handle, cfg, params
     serve.shutdown()
+    ray_tpu.shutdown()
 
 
 def test_serve_generate_and_stream(serve_llm):

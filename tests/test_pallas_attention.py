@@ -32,8 +32,7 @@ def _rand(shape, key, dtype=jnp.float32):
     return jax.random.normal(key, shape, dtype=jnp.float32).astype(dtype)
 
 
-@pytest.mark.parametrize("causal", [True, False])
-@pytest.mark.parametrize("kvh", [4, 2, 1])
+@pytest.mark.parametrize("causal,kvh", [(True, 4), (True, 1), (False, 2)])
 def test_forward_matches_reference(causal, kvh):
     B, S, H, hd = 2, 256, 4, 128
     ks = jax.random.split(jax.random.PRNGKey(0), 3)

@@ -270,3 +270,69 @@ def test_workflow_durable_resume(ray_start_regular, tmp_path):
     assert open(calls / "a").read() == "x"      # ran once
     assert open(calls / "b").read() == "xx"     # failed once, retried once
     assert {"workflow_id": "wf1", "status": "SUCCEEDED"} in workflow.list_all()
+
+
+# ------------------------------------------------- small util components
+def test_actor_group(ray_start_regular):
+    from ray_tpu.util.actor_group import ActorGroup
+
+    class Member:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    grp = ActorGroup(Member, 3, init_args=(100,))
+    assert grp.execute("add", 5) == [105, 105, 105]
+    assert grp.execute_single(1, "add", 1) == 101
+    grp.restart_actor(0)
+    assert grp.execute("add", 2) == [102, 102, 102]
+    grp.shutdown()
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    def add(a, b):
+        return a + b
+
+    with Pool() as pool:
+        assert pool.map(square, range(6)) == [0, 1, 4, 9, 16, 25]
+        assert pool.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(add, (5, 6)) == 11
+        r = pool.map_async(square, [7])
+        assert r.get(timeout=30) == [49]
+        assert sorted(pool.imap_unordered(square, range(4))) == [0, 1, 4, 9]
+    with pytest.raises(ValueError):
+        pool.map(square, [1])
+
+
+def test_state_api_lists_and_summaries(ray_start_regular):
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def traced_fn():
+        return 1
+
+    @ray_tpu.remote
+    class StateActor:
+        def ping(self):
+            return 1
+
+    a = StateActor.remote()
+    ray_tpu.get([a.ping.remote(), traced_fn.remote()])
+
+    actors = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert actors and all(x["state"] == "ALIVE" for x in actors)
+    assert state.list_nodes()[0]["node_id"] == "node0"
+    summary = state.summarize_tasks()
+    assert summary["total"] >= 1
+    assert summary["by_state"].get("FINISHED", 0) >= 1
+    assert "traced_fn" in summary["by_func_name"]
+    assert state.summarize_actors()["by_state"].get("ALIVE", 0) >= 1
+    assert state.summarize_objects()["total"] >= 1
+    ray_tpu.kill(a)

@@ -23,18 +23,18 @@ def algo(ray_start_4_cpus, tmp_path):
     a.stop()
 
 
-def test_train_iteration_metrics(algo):
+def test_ppo_learns_cartpole(algo, tmp_path):
+    """Convergence + per-iteration metrics + checkpoint roundtrip +
+    action API in one fixture lifetime (each fixture spawns env-runner
+    workers that pay a fresh jax import — consolidating keeps the suite
+    inside the driver budget without losing assertions)."""
     result = algo.train()
     assert result["training_iteration"] == 1
     assert result["num_env_steps_sampled_lifetime"] == 2 * 2 * 64
     assert np.isfinite(result["policy_loss"])
     assert np.isfinite(result["vf_loss"])
-
-
-def test_ppo_learns_cartpole(algo):
-    first = None
-    last = None
-    for i in range(12):
+    first = last = result["episode_return_mean"] if result["num_episodes"] else None
+    for i in range(11):
         r = algo.train()
         if first is None and r["num_episodes"] > 0:
             first = r["episode_return_mean"]
@@ -44,23 +44,17 @@ def test_ppo_learns_cartpole(algo):
     # CartPole random policy ~20; after ~6k steps PPO should be well up
     assert last > first + 20, (first, last)
 
-
-def test_checkpoint_roundtrip(algo, tmp_path):
-    algo.train()
     path = algo.save(str(tmp_path / "ck"))
     it = algo.iteration
     algo.train()
     algo.restore(path)
     assert algo.iteration == it
 
-
-def test_compute_single_action(algo):
     import gymnasium as gym
 
     env = gym.make("CartPole-v1")
     obs, _ = env.reset(seed=0)
-    a = algo.compute_single_action(obs)
-    assert a in (0, 1)
+    assert algo.compute_single_action(obs) in (0, 1)
 
 
 # --------------------------------------------------------------- IMPALA
@@ -81,7 +75,9 @@ def impala_algo(ray_start_4_cpus):
     a.stop()
 
 
-def test_impala_iteration_metrics(impala_algo):
+def test_impala_learns_cartpole(impala_algo, tmp_path):
+    """Async actor-learner convergence + metrics + checkpoint roundtrip
+    (reference: rllib IMPALA tuned_examples bar)."""
     r = impala_algo.train()
     assert r["training_iteration"] == 1
     # 8 async updates x 2 envs x 64 steps
@@ -89,13 +85,8 @@ def test_impala_iteration_metrics(impala_algo):
     assert np.isfinite(r["policy_loss"]) and np.isfinite(r["vf_loss"])
     # off-policyness is bounded: mean importance ratio stays near 1
     assert 0.5 < r["mean_rho"] < 2.0
-
-
-def test_impala_learns_cartpole(impala_algo):
-    """Async actor-learner convergence regression (reference:
-    rllib IMPALA tuned_examples bar)."""
-    first = last = None
-    for _ in range(12):
+    first = last = r["episode_return_mean"] if r["num_episodes"] else None
+    for _ in range(11):
         r = impala_algo.train()
         if first is None and r["num_episodes"] > 0:
             first = r["episode_return_mean"]
@@ -104,9 +95,6 @@ def test_impala_learns_cartpole(impala_algo):
     assert first is not None and last is not None
     assert last > first + 20, (first, last)
 
-
-def test_impala_checkpoint_roundtrip(impala_algo, tmp_path):
-    impala_algo.train()
     path = impala_algo.save(str(tmp_path / "ck"))
     it = impala_algo.iteration
     impala_algo.train()
@@ -156,7 +144,7 @@ def test_dqn_learns_cartpole(ray_start_4_cpus):
     )
     try:
         first = last = None
-        for _ in range(24):
+        for _ in range(21):
             r = a.train()
             if first is None and r["num_episodes"] > 0:
                 first = r["episode_return_mean"]
