@@ -1133,11 +1133,19 @@ class Hub:
                 else:
                     # the whole class is blocked; if the head wanted a
                     # worker, the rest of the queue wants one too (keeps
-                    # warm-up spawning parallel, not one-per-pass)
+                    # warm-up spawning parallel, not one-per-pass). Each
+                    # want carries ITS OWN spec's actor flag — the head's
+                    # flag must not leak onto queued plain tasks (that
+                    # would bypass the pooled-worker cap).
                     if self._last_spawn_node is not None and len(q) > 1:
                         self._spawn_wants.setdefault(
                             self._last_spawn_node, []
-                        ).extend([self._last_spawn_env] * (len(q) - 1))
+                        ).extend(
+                            (s.options.get("runtime_env"),
+                             s.options.get("runtime_env_hash", ""),
+                             s.is_actor_create)
+                            for s in list(q)[1:]
+                        )
                     break
             if not q:
                 empty_keys.append(key)
@@ -1425,6 +1433,9 @@ class Hub:
             if actor is not None:
                 actor.inflight.pop(p["task_id"], None)
         node_id = worker.node_id if worker is not None else "node0"
+        if self._maybe_retry_app_error(spec, p["returns"]):
+            self._dispatch()
+            return
         if spec is not None and spec.actor_id is None and not spec.is_actor_create:
             for oid, kind, _, _ in p["returns"]:
                 if kind == P.VAL_SHM:
@@ -1445,6 +1456,41 @@ class Hub:
         for oid, kind, payload, size in p["returns"]:
             self._object_ready(oid, kind, payload, size, node_id=node_id)
         self._dispatch()
+
+    def _maybe_retry_app_error(self, spec, returns) -> bool:
+        """retry_exceptions (reference: @ray.remote(retry_exceptions=...)):
+        application errors normally publish immediately; with the option
+        set (True, or a list of exception types) the task re-enqueues
+        against its retry budget instead."""
+        if (
+            spec is None
+            or spec.is_actor_create
+            or spec.actor_id is not None
+            or spec.retries_left <= 0
+            or not spec.options.get("retry_exceptions")
+            or not any(kind == P.VAL_ERROR for _, kind, _, _ in returns)
+        ):
+            return False
+        allowed = spec.options["retry_exceptions"]
+        if isinstance(allowed, (list, tuple)):
+            try:
+                payload = next(
+                    pl for _, kind, pl, _ in returns if kind == P.VAL_ERROR
+                )
+                err = loads_inline(payload)
+                cause = getattr(err, "cause", None)
+                match = isinstance(err, tuple(allowed)) or isinstance(
+                    cause, tuple(allowed)
+                )
+            except Exception:
+                match = False
+            if not match:
+                return False
+        spec.retries_left -= 1
+        self.tasks[spec.task_id] = spec
+        self._task_event(spec.task_id, state="PENDING_RETRY")
+        self._enqueue_runnable(spec)
+        return True
 
     def _release_task_resources(self, spec: TaskSpec):
         pool = spec.options.pop("_pool", None)

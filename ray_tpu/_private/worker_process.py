@@ -102,11 +102,18 @@ class WorkerRuntime:
             # hub-sent SIGINT = cooperative cancellation (ray.cancel)
             err: Exception = TaskCancelledError("task was cancelled")
         else:
-            err = TaskError(fn_name, tb, cause=None)
+            # keep the original exception as the cause (retry_exceptions
+            # type filters and user handlers match on it); fall back to
+            # cause=None when it does not pickle
+            err = TaskError(fn_name, tb, cause=exc)
         try:
             blob = dumps_inline(err)
         except Exception:
-            blob = dumps_inline(TaskError(fn_name, tb))
+            try:
+                err = TaskError(fn_name, tb, cause=None)
+                blob = dumps_inline(err)
+            except Exception:
+                blob = dumps_inline(TaskError(fn_name, tb))
         return [(oid, P.VAL_ERROR, blob, 0) for oid in return_ids]
 
     def _stream_yield_one(self, p: dict, value) -> None:

@@ -106,6 +106,10 @@ def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
             out["strategy"] = strategy
     if opts.get("max_retries") is not None:
         out["max_retries"] = opts["max_retries"]
+    if opts.get("retry_exceptions"):
+        # True = retry any application error; a list/tuple retries only
+        # matching exception types (reference: ray_option_utils semantics)
+        out["retry_exceptions"] = opts["retry_exceptions"]
     return out
 
 

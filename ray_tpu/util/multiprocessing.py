@@ -35,6 +35,10 @@ class AsyncResult:
         return len(done) == len(self._refs)
 
     def successful(self) -> bool:
+        if not self.ready():
+            # multiprocessing contract: querying an unfinished result is
+            # an error, not False
+            raise ValueError("AsyncResult is not ready")
         try:
             self.get(timeout=0.001)
             return True
@@ -45,8 +49,10 @@ class AsyncResult:
 class Pool:
     """Process pool on cluster workers (reference: util/multiprocessing).
 
-    processes bounds in-flight task parallelism (the runtime's worker
-    pool does the real scaling)."""
+    ``processes`` bounds in-flight submission on the lazy/sync paths
+    (map/starmap/apply/imap*); the *_async methods submit their whole
+    input eagerly, matching their return-immediately contract. The
+    runtime's worker pool does the real scaling."""
 
     def __init__(self, processes: Optional[int] = None):
         import ray_tpu
@@ -59,10 +65,10 @@ class Pool:
 
     # -- sync ----------------------------------------------------------
     def map(self, func: Callable, iterable: Iterable) -> List[Any]:
-        return self.map_async(func, iterable).get()
+        return list(self.imap(func, iterable))
 
     def starmap(self, func: Callable, iterable: Iterable) -> List[Any]:
-        return self.starmap_async(func, iterable).get()
+        return list(self.imap(lambda pair: func(*pair), iterable))
 
     def apply(self, func: Callable, args: tuple = (), kwds: dict = None):
         return self.apply_async(func, args, kwds).get()
@@ -89,21 +95,45 @@ class Pool:
         rf = self._remote(func)
         return AsyncResult([rf.remote(*args, **(kwds or {}))], single=True)
 
+    def _window(self) -> int:
+        return self._processes or 64
+
     def imap(self, func: Callable, iterable: Iterable):
+        """Lazy, windowed (stdlib imap consumes the iterable
+        incrementally — so does this, keeping <= window in flight)."""
         self._check_open()
+        from collections import deque
+
         rf = self._remote(func)
-        refs = [rf.remote(x) for x in iterable]
-        for ref in refs:
-            yield self._ray.get(ref)
+        it = iter(iterable)
+        inflight: deque = deque()
+        try:
+            while len(inflight) < self._window():
+                inflight.append(rf.remote(next(it)))
+        except StopIteration:
+            pass
+        while inflight:
+            yield self._ray.get(inflight.popleft())
+            try:
+                inflight.append(rf.remote(next(it)))
+            except StopIteration:
+                pass
 
     def imap_unordered(self, func: Callable, iterable: Iterable):
         self._check_open()
         rf = self._remote(func)
-        pending = {rf.remote(x) for x in iterable}
-        while pending:
-            done, rest = self._ray.wait(
-                list(pending), num_returns=1, timeout=60
-            )
+        it = iter(iterable)
+        pending = set()
+        exhausted = False
+        while True:
+            while not exhausted and len(pending) < self._window():
+                try:
+                    pending.add(rf.remote(next(it)))
+                except StopIteration:
+                    exhausted = True
+            if not pending:
+                return
+            done, _ = self._ray.wait(list(pending), num_returns=1, timeout=60)
             for ref in done:
                 pending.discard(ref)
                 yield self._ray.get(ref)

@@ -177,3 +177,40 @@ def test_application_error_not_retried(ray_start_regular):
     # application errors are not retried (only worker crashes are)
     assert os.path.getsize(calls_file) == 1
     os.unlink(calls_file)
+
+
+def test_retry_exceptions_true(ray_start_regular):
+    """retry_exceptions=True retries application errors (reference:
+    @ray.remote(retry_exceptions=True))."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "tries")
+
+        @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+        def flaky_app():
+            with open(marker, "a") as f:
+                f.write("x")
+            if os.path.getsize(marker) < 3:
+                raise ValueError("transient")
+            return "ok"
+
+        assert ray_tpu.get(flaky_app.remote(), timeout=60) == "ok"
+        assert os.path.getsize(marker) == 3
+
+
+def test_retry_exceptions_type_filter(ray_start_regular):
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        marker = os.path.join(d, "tries")
+
+        @ray_tpu.remote(max_retries=3, retry_exceptions=[KeyError])
+        def wrong_type():
+            with open(marker, "a") as f:
+                f.write("x")
+            raise ValueError("not retryable")
+
+        with pytest.raises(TaskError):
+            ray_tpu.get(wrong_type.remote(), timeout=60)
+        assert os.path.getsize(marker) == 1  # ValueError not in the list

@@ -336,3 +336,29 @@ def test_state_api_lists_and_summaries(ray_start_regular):
     assert state.summarize_actors()["by_state"].get("ALIVE", 0) >= 1
     assert state.summarize_objects()["total"] >= 1
     ray_tpu.kill(a)
+
+
+def test_pool_windowed_lazy_imap(ray_start_regular):
+    """processes bounds in-flight submission on the lazy paths; imap
+    consumes more items than the window without hanging."""
+    from ray_tpu.util.multiprocessing import Pool
+
+    def ident(x):
+        return x
+
+    with Pool(processes=2) as pool:
+        assert list(pool.imap(ident, range(9))) == list(range(9))
+        assert sorted(pool.imap_unordered(ident, range(7))) == list(range(7))
+        r = pool.map_async(ident, [1])
+        assert r.get(timeout=30) == [1]
+        assert r.ready() and r.successful()
+
+    # successful() on an unfinished result raises (multiprocessing
+    # contract) — use a result that can never complete
+    from ray_tpu.util.multiprocessing import AsyncResult
+    from ray_tpu.object_ref import ObjectRef
+    from ray_tpu._private.ids import ObjectID
+
+    ghost = AsyncResult([ObjectRef(ObjectID.generate())], single=False)
+    with pytest.raises(ValueError):
+        ghost.successful()
