@@ -119,7 +119,15 @@ class CoreClient:
     def _read_loop(self) -> None:
         try:
             while True:
-                blob = self.conn.recv_bytes()
+                try:
+                    blob = self.conn.recv_bytes()
+                except TypeError:
+                    # Connection.close() from another thread nulls the fd
+                    # mid-recv (os.read(None, ...)) — same benign shutdown
+                    # race as EOFError. Only the recv call gets this
+                    # treatment; a TypeError in dispatch below is a real bug
+                    # and must propagate.
+                    raise EOFError("connection closed during recv")
                 msg_type, payload = loads_inline(blob)
                 if msg_type == P.REPLY:
                     req_id = payload["req_id"]

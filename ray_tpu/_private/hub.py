@@ -1235,7 +1235,6 @@ class Hub:
                      spec.is_actor_create)
                 )
                 self._last_spawn_node = node.node_id
-                self._last_spawn_env = self._spawn_wants[node.node_id][-1]
                 break
         return "defer"
 

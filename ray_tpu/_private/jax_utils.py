@@ -1,0 +1,53 @@
+"""Guarded JAX accelerator discovery.
+
+jax.devices() initializes the PJRT plugin; on a tunneled TPU (axon)
+that can block for minutes when the tunnel is wedged. Nothing in the
+control plane is allowed to hang on accelerator discovery, so the
+probe runs in a throwaway subprocess with a hard timeout unless a
+backend is already live in-process (then it's cheap and exact).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_cached: Optional[int] = None
+
+
+def safe_tpu_device_count() -> int:
+    """TPU/axon device count, never blocking longer than
+    RAY_TPU_DETECT_TIMEOUT (default 20s). Returns 0 on any failure."""
+    global _cached
+    if _cached is not None:
+        return _cached
+    if "jax" not in sys.modules:
+        _cached = 0
+        return 0
+    import jax
+
+    if jax._src.xla_bridge._backends:
+        try:
+            _cached = sum(
+                1 for d in jax.devices() if d.platform in ("tpu", "axon")
+            )
+        except Exception:
+            _cached = 0
+        return _cached
+    try:
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(sum(1 for d in jax.devices()"
+                " if d.platform in ('tpu', 'axon')))",
+            ],
+            capture_output=True,
+            timeout=float(os.environ.get("RAY_TPU_DETECT_TIMEOUT", "20")),
+        )
+        _cached = int(out.stdout.strip() or 0)
+    except Exception:
+        _cached = 0
+    return _cached

@@ -51,16 +51,9 @@ def _detect_num_tpus() -> int:
     env = os.environ.get("RAY_TPU_NUM_TPUS")
     if env is not None:
         return int(env)
-    import sys
+    from .jax_utils import safe_tpu_device_count
 
-    if "jax" in sys.modules:
-        try:
-            import jax
-
-            return sum(1 for d in jax.devices() if d.platform in ("tpu", "axon"))
-        except Exception:
-            return 0
-    return 0
+    return safe_tpu_device_count()
 
 
 def init(
@@ -105,6 +98,9 @@ def init(
             _client.inline_only = True  # no shared /dev/shm with the cluster
             if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
                 _subscribe_worker_logs(_client)
+            from . import usage
+
+            usage.flush_pending()
             atexit.register(shutdown)
             return RuntimeContext()
 
@@ -150,6 +146,9 @@ def init(
         _client = CoreClient(_hub.addr, _session_dir, role="driver", worker_id="driver")
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             _subscribe_worker_logs(_client)
+        from . import usage
+
+        usage.flush_pending()
         atexit.register(shutdown)
         return RuntimeContext()
 
@@ -165,6 +164,9 @@ def _subscribe_worker_logs(client: CoreClient) -> None:
             print(f"(worker pid={rec.get('pid')}) {line}", file=stream)
 
     client.subscribe("__logs__", on_log)
+    from ..experimental import tqdm_ray
+
+    tqdm_ray._driver_subscribe(client)
 
 
 def shutdown() -> None:
@@ -208,6 +210,22 @@ class RuntimeContext:
 
     def __exit__(self, *a):
         shutdown()
+
+    def _repr_html_(self):
+        # Jupyter card (reference: python/ray/widgets context repr).
+        from .. import widgets
+
+        res = cluster_resources()
+        return widgets.card_html(
+            "ray_tpu cluster",
+            {
+                "address": self.address_info["address"],
+                "nodes": len(nodes()),
+                "CPU": res.get("CPU", 0),
+                "TPU": res.get("TPU", 0),
+                "memory": f"{res.get('memory', 0) / 1024**3:.1f} GiB",
+            },
+        )
 
 
 # --------------------------------------------------------------------- verbs

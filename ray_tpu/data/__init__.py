@@ -36,3 +36,7 @@ __all__ = [
     "read_binary_files", "read_csv", "read_datasource", "read_json",
     "read_numpy", "read_parquet", "read_text",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("data")

@@ -391,6 +391,16 @@ class Dataset:
         ops = [op.name for op in self._logical.ops()]
         return f"Dataset(plan={' -> '.join(ops)})"
 
+    def _repr_html_(self):
+        # Jupyter card (reference: python/ray/widgets dataset repr).
+        # Plan-only — no execution triggered by displaying a dataset.
+        from ray_tpu import widgets
+
+        ops = [op.name for op in self._logical.ops()]
+        return widgets.dataset_html(
+            "ray_tpu.data.Dataset", None, [], {"plan": " -> ".join(ops)}
+        )
+
 
 def _json_safe(v):
     if isinstance(v, np.generic):

@@ -19,3 +19,7 @@ __all__ = [
     "build_llm_processor",
     "save_params_npz",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("llm")

@@ -32,3 +32,7 @@ __all__ = [
     "ReplayBuffer",
     "PrioritizedReplayBuffer",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("rllib")

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 from .batching import batch
 from .handle import DeploymentHandle, DeploymentResponse
+from .multiplex import get_multiplexed_model_id, multiplexed
 from ._private.controller import CONTROLLER_NAME, DeploymentInfo, ServeController
 
 __all__ = [
@@ -26,6 +27,8 @@ __all__ = [
     "delete",
     "deployment",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "shutdown",
     "start",
@@ -260,3 +263,7 @@ def shutdown() -> None:
         except Exception:
             pass
         _proxy = None
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("serve")

@@ -41,6 +41,9 @@ class ServeController:
         self._replicas: Dict[str, List[Any]] = {}  # name -> actor handles
         self._replica_versions: Dict[str, List[int]] = {}
         self._ping_misses: Dict[bytes, int] = {}  # consecutive health misses
+        # deployment -> {replica id -> loaded multiplexed model ids};
+        # refreshed from the same batched ping (multiplex routing info)
+        self._model_ids: Dict[str, Dict[bytes, List[str]]] = {}
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
@@ -62,6 +65,15 @@ class ServeController:
     def get_replicas(self, name: str) -> List[Any]:
         with self._lock:
             return list(self._replicas.get(name, []))
+
+    def get_multiplex_map(self, name: str) -> Dict[bytes, List[str]]:
+        """replica id -> loaded model ids (router model-affinity info;
+        reference: multiplexed_replicas broadcast via LongPollHost)."""
+        with self._lock:
+            return {
+                rid: list(ids)
+                for rid, ids in self._model_ids.get(name, {}).items()
+            }
 
     def list_deployments(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -124,17 +136,23 @@ class ServeController:
             # O(replicas) control latency, r1 Weak finding). A slow
             # replica is only retired after 3 consecutive missed pings
             # (reference: health_check_failure_threshold).
-            refs = [actor.queue_len.remote() for actor in live]
+            refs = [actor.stats.remote() for actor in live]
             done, _ = ray_tpu.wait(
                 refs, num_returns=len(refs), timeout=5.0
             ) if refs else ([], [])
             done_set = set(done)
             alive, alive_vers = [], []
+            with self._lock:
+                model_map = self._model_ids.setdefault(name, {})
             for actor, ver, ref in zip(live, versions, refs):
                 rid = actor._actor_id.binary()
                 if ref in done_set:
                     try:
-                        ray_tpu.get(ref)
+                        stats = ray_tpu.get(ref)
+                        mux = stats.get("multiplexed_model_ids") or []
+                        with self._lock:
+                            if mux or rid in model_map:
+                                model_map[rid] = list(mux)
                         healthy = True
                         self._ping_misses.pop(rid, None)
                     except Exception:
@@ -169,6 +187,10 @@ class ServeController:
             with self._lock:
                 self._replicas[name] = alive
                 self._replica_versions[name] = alive_vers
+                alive_rids = {a._actor_id.binary() for a in alive}
+                for rid in list(model_map):
+                    if rid not in alive_rids:
+                        del model_map[rid]
         # GC deleted deployments
         with self._lock:
             for name in list(self._replicas):

@@ -47,7 +47,13 @@ class Replica:
         return self._ongoing
 
     def stats(self) -> Dict[str, Any]:
-        return {"ongoing": self._ongoing, "total": self._total}
+        from ..multiplex import registered_model_ids
+
+        return {
+            "ongoing": self._ongoing,
+            "total": self._total,
+            "multiplexed_model_ids": registered_model_ids(),
+        }
 
     def check_health(self) -> bool:
         fn = getattr(self.instance, "check_health", None)
@@ -60,10 +66,19 @@ class Replica:
             self.instance.reconfigure(user_config)
 
     # -- request path --------------------------------------------------
-    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
+    def handle_request(
+        self,
+        method_name: str,
+        args: Tuple,
+        kwargs: Dict,
+        multiplexed_model_id: str = "",
+    ):
+        from ..multiplex import _model_id_ctx
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        token = _model_id_ctx.set(multiplexed_model_id)
         try:
             target = (
                 self.instance
@@ -72,19 +87,41 @@ class Replica:
             )
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
-                result = _run_coro(result)
+                # the coroutine executes on the replica loop THREAD —
+                # re-enter the model-id context there, the caller
+                # thread's contextvar doesn't cross
+                async def _with_ctx(coro=result):
+                    tok = _model_id_ctx.set(multiplexed_model_id)
+                    try:
+                        return await coro
+                    finally:
+                        _model_id_ctx.reset(tok)
+
+                result = _run_coro(_with_ctx())
             return result
         finally:
+            _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1
 
-    def handle_request_streaming(self, method_name: str, args: Tuple, kwargs: Dict):
+    def handle_request_streaming(
+        self,
+        method_name: str,
+        args: Tuple,
+        kwargs: Dict,
+        multiplexed_model_id: str = "",
+    ):
         """Generator variant: invoked with num_returns="streaming" so
         each yielded chunk becomes an incremental stream object
         (reference: Serve streaming responses over ObjectRefGenerator)."""
+        from ..multiplex import _model_id_ctx
+
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        # no reset token: the executor drives one task at a time, and
+        # generator frames don't carry their own context anyway
+        _model_id_ctx.set(multiplexed_model_id)
         try:
             target = (
                 self.instance
@@ -92,18 +129,28 @@ class Replica:
                 else getattr(self.instance, method_name)
             )
             result = target(*args, **kwargs)
+
+            async def _with_ctx(coro):
+                # async steps execute on the replica loop THREAD; re-enter
+                # the model-id context there (mirror of handle_request)
+                tok = _model_id_ctx.set(multiplexed_model_id)
+                try:
+                    return await coro
+                finally:
+                    _model_id_ctx.reset(tok)
+
             if inspect.isgenerator(result):
                 yield from result
             elif inspect.isasyncgen(result):
                 # drain the async generator on the replica's loop
                 while True:
                     try:
-                        yield _run_coro(result.__anext__())
+                        yield _run_coro(_with_ctx(result.__anext__()))
                     except StopAsyncIteration:
                         break
             else:
                 if inspect.iscoroutine(result):
-                    result = _run_coro(result)
+                    result = _run_coro(_with_ctx(result))
                 yield result
         finally:
             with self._lock:

@@ -114,6 +114,8 @@ class DeploymentHandle:
         self.deployment_name = deployment_name
         self.method_name = method_name
         self._stream = False
+        self._model_id = ""
+        self._model_map: Dict[bytes, List[str]] = {}
         self._replicas: List[Any] = []
         self._outstanding: Dict[int, int] = {}
         self._inflight: Dict[Any, int] = {}  # ref -> replica id
@@ -131,12 +133,17 @@ class DeploymentHandle:
         *,
         method_name: Optional[str] = None,
         stream: Optional[bool] = None,
+        multiplexed_model_id: Optional[str] = None,
     ) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, method_name or self.method_name)
         h._replicas = self._replicas
         h._outstanding = self._outstanding
         h._refreshed = self._refreshed
         h._stream = self._stream if stream is None else stream
+        h._model_id = (
+            self._model_id if multiplexed_model_id is None else multiplexed_model_id
+        )
+        h._model_map = self._model_map
         return h
 
     def __getattr__(self, name: str):
@@ -163,10 +170,15 @@ class DeploymentHandle:
             self._refreshed = now
         import ray_tpu
 
-        replicas = ray_tpu.get(
-            self._controller().get_replicas.remote(self.deployment_name)
+        ctrl = self._controller()
+        replicas = ray_tpu.get(ctrl.get_replicas.remote(self.deployment_name))
+        model_map = (
+            ray_tpu.get(ctrl.get_multiplex_map.remote(self.deployment_name))
+            if self._model_id
+            else {}
         )
         with self._lock:
+            self._model_map = model_map
             self._replicas = replicas
             # keyed by the STABLE actor id — ActorHandle objects are
             # re-created on every refresh deserialization, so id() keys
@@ -201,6 +213,18 @@ class DeploymentHandle:
                 )
             time.sleep(0.05)
         self._reconcile_inflight()
+        if self._model_id:
+            # model affinity (reference pow_2_scheduler multiplex rank):
+            # pick among replicas already holding the model; fall back
+            # to the full set (the chosen replica then loads it)
+            with self._lock:
+                holders = [
+                    r
+                    for r in replicas
+                    if self._model_id in self._model_map.get(_rid(r), ())
+                ]
+            if holders:
+                replicas = holders
         replica = self._pick(replicas)
         rid = _rid(replica)
         if self._stream:
@@ -211,11 +235,11 @@ class DeploymentHandle:
             # the count back against
             ref_gen = replica.handle_request_streaming.options(
                 num_returns="streaming"
-            ).remote(method, args, kwargs)
+            ).remote(method, args, kwargs, self._model_id)
             return DeploymentResponseGenerator(ref_gen)
         with self._lock:
             self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
-        ref = replica.handle_request.remote(method, args, kwargs)
+        ref = replica.handle_request.remote(method, args, kwargs, self._model_id)
         with self._lock:
             self._inflight[ref] = rid
         return DeploymentResponse(ref, self, method, args, kwargs)

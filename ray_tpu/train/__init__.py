@@ -44,3 +44,7 @@ __all__ = [
     "get_dataset_shard",
     "report",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("train")

@@ -56,3 +56,7 @@ __all__ = [
     "uniform",
     "with_resources",
 ]
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("tune")

@@ -51,17 +51,11 @@ def get_num_tpu_chips_on_node() -> int:
     env = os.environ.get("RAY_TPU_NUM_TPUS") or os.environ.get("TPU_NUM_DEVICES")
     if env:
         return int(env)
-    import sys
+    from ray_tpu._private.jax_utils import safe_tpu_device_count
 
-    if "jax" in sys.modules:
-        try:
-            import jax
-
-            n = sum(1 for d in jax.devices() if d.platform in ("tpu", "axon"))
-            if n:
-                return n
-        except Exception:
-            pass
+    n = safe_tpu_device_count()
+    if n:
+        return n
     gen = get_accelerator_type()
     if gen:
         acc = os.environ.get("TPU_ACCELERATOR_TYPE", "")

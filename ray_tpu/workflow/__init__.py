@@ -126,3 +126,7 @@ def run(dag: DAGNode, *, workflow_id: str, args: Any = None) -> Any:
 def resume(workflow_id: str, dag: DAGNode, *, args: Any = None) -> Any:
     """Alias of run() — resumption IS re-running with the same id."""
     return run(dag, workflow_id=workflow_id, args=args)
+
+from ray_tpu._private import usage as _usage
+
+_usage.record_library_usage("workflow")

@@ -22,8 +22,31 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # Persistent compilation cache shared by the test process AND every
 # spawned worker process (env inherits): each worker would otherwise
 # re-jit identical tiny programs, which dominates suite wall time on
-# this 1-core box.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/ray_tpu_jax_test_cache")
+# this 1-core box. The dir is keyed by a host fingerprint: XLA:CPU AOT
+# artifacts embed the compile machine's CPU features, and loading a
+# cache populated on a different host (e.g. a container snapshot moved
+# between machines) spews per-program feature-mismatch errors and
+# recompiles — slower than no cache at all.
+
+
+def _host_cache_dir() -> str:
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next(
+                (ln for ln in f if ln.startswith("flags")), platform.processor()
+            )
+    except OSError:
+        flags = platform.processor()
+    fp = hashlib.sha256(
+        (platform.machine() + str(flags)).encode()
+    ).hexdigest()[:12]
+    return f"/tmp/ray_tpu_jax_test_cache_{fp}"
+
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _host_cache_dir())
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
@@ -37,6 +60,16 @@ import jax
 # jax.config at interpreter start, overriding JAX_PLATFORMS env; pin
 # the config back to cpu so tests run on the virtual 8-device mesh.
 jax.config.update("jax_platforms", "cpu")
+# Same problem for the cache env vars: sitecustomize imported jax at
+# interpreter start, before this file set the env — config-bound
+# values were already baked, so set them on the config directly too
+# (worker processes spawn with the env above and pick it up normally).
+# Mirror whichever value won the setdefault, so a user-provided dir is
+# respected in both the main process and workers.
+jax.config.update(
+    "jax_compilation_cache_dir", os.environ["JAX_COMPILATION_CACHE_DIR"]
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import signal
 

@@ -1,0 +1,136 @@
+"""Smaller user-visible components: usage stats, tqdm, widgets, rpdb,
+Serve model multiplexing (SURVEY.md §2.2 usage/telemetry, §2.4
+debugging/widgets, Serve multiplex.py)."""
+
+import io
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_usage_stats_report(ray_start_regular):
+    import ray_tpu.data  # records library usage on import
+    from ray_tpu._private import usage
+
+    usage.record_library_usage("data")
+    usage.record_extra_usage_tag("test_tag", "42")
+    report = usage.get_usage_report()
+    assert "data" in report["library_usages"]
+    assert report["extra_usage_tags"]["test_tag"] == "42"
+    assert report["total_num_nodes"] >= 1
+    path = usage.write_usage_report(ray_tpu._private.worker._session_dir)
+    import json
+
+    with open(path) as f:
+        assert json.load(f)["source"] == "ray_tpu"
+
+
+def test_tqdm_worker_bars(ray_start_regular):
+    from ray_tpu.experimental import tqdm_ray
+
+    @ray_tpu.remote
+    def work(n):
+        bar = tqdm_ray.tqdm(desc="progress", total=n)
+        for _ in range(n):
+            bar.update(1)
+        bar.close()
+        return n
+
+    assert ray_tpu.get(work.remote(7)) == 7
+    # driver-local bar: iterator protocol
+    seen = list(tqdm_ray.tqdm(range(4), desc="local"))
+    assert seen == [0, 1, 2, 3]
+
+
+def test_widgets_html_reprs(ray_start_regular):
+    ctx = ray_tpu._private.worker.RuntimeContext()
+    html = ctx._repr_html_()
+    assert "ray_tpu cluster" in html and "CPU" in html
+
+    import ray_tpu.data as rd
+
+    ds = rd.range(10).map(lambda r: r)
+    html = ds._repr_html_()
+    assert "Dataset" in html and "plan" in html
+
+
+def test_rpdb_breakpoint_attach(ray_start_regular):
+    from ray_tpu.util import rpdb
+
+    @ray_tpu.remote
+    def buggy():
+        x = 41
+        rpdb.set_trace()
+        return x + 1
+
+    ref = buggy.remote()
+    deadline = time.monotonic() + 20
+    while not rpdb.list_breakpoints():
+        assert time.monotonic() < deadline, "breakpoint never registered"
+        time.sleep(0.05)
+    out = io.StringIO()
+    rpdb.connect(stdin=io.StringIO("p x\nc\n"), stdout=out)
+    assert "41" in out.getvalue()
+    assert ray_tpu.get(ref, timeout=30) == 42
+    assert rpdb.list_breakpoints() == []
+
+
+def test_serve_multiplexed_model_loading(ray_start_4_cpus):
+    from ray_tpu import serve
+
+    loads = []
+
+    @serve.deployment(num_replicas=1)
+    class MuxModel:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return {"id": model_id}
+
+        def __call__(self, x):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            return (model["id"], x)
+
+    handle = serve.run(MuxModel.bind(), route_prefix=None)
+    try:
+        r1 = handle.options(multiplexed_model_id="m1").remote(1).result(timeout_s=30)
+        assert r1 == ("m1", 1)
+        r2 = handle.options(multiplexed_model_id="m2").remote(2).result(timeout_s=30)
+        assert r2 == ("m2", 2)
+        # LRU eviction: cap is 2; a third id must still work
+        r3 = handle.options(multiplexed_model_id="m3").remote(3).result(timeout_s=30)
+        assert r3 == ("m3", 3)
+    finally:
+        serve.shutdown()
+
+
+def test_serve_multiplex_routing_prefers_holder(ray_start_4_cpus):
+    """With 2 replicas, repeated calls for one model id should land on
+    the replica that already holds it once the controller has seen it."""
+    import os
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Who:
+        @serve.multiplexed(max_num_models_per_replica=4)
+        def get_model(self, model_id: str):
+            return model_id
+
+        def __call__(self):
+            self.get_model(serve.get_multiplexed_model_id())
+            return os.getpid()
+
+    handle = serve.run(Who.bind(), route_prefix=None)
+    try:
+        h = handle.options(multiplexed_model_id="modelA")
+        first = h.remote().result(timeout_s=30)
+        # give the controller one ping round to learn the model map,
+        # then expire the handle's cached routing state
+        time.sleep(1.0)
+        h._refresh(force=True)
+        pids = {h.remote().result(timeout_s=30) for _ in range(6)}
+        assert pids == {first}, f"expected affinity to {first}, got {pids}"
+    finally:
+        serve.shutdown()
