@@ -38,7 +38,30 @@ def _detect_peak() -> float:
     return PEAK_TFLOPS["v5e"]
 
 
+def _ensure_live_backend() -> None:
+    """The TPU arrives over a tunnel (axon PJRT); if the tunnel is
+    wedged, jax.devices() blocks forever. Probe it (shared helper,
+    subprocess + hard timeout) and fall back to CPU rather than
+    hanging the whole bench run."""
+    import sys as _sys
+
+    from ray_tpu._private.jax_utils import probe_accelerator
+
+    platform, _ = probe_accelerator()
+    if platform in ("tpu", "axon"):
+        return
+    import jax
+
+    print(
+        f"bench: accelerator probe returned {platform!r}; "
+        "falling back to CPU",
+        file=_sys.stderr,
+    )
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
+    _ensure_live_backend()
     import jax
     import jax.numpy as jnp
 

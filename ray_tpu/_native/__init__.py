@@ -1,0 +1,98 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference implements its channel/object plane in C++
+(src/ray/core_worker/experimental_mutable_object_manager.h, plasma in
+src/ray/object_manager/plasma/); this package holds the TPU-native
+equivalents. Modules are compiled once per host into a cache dir keyed
+by source hash, so a fresh checkout pays one ~2s g++ run and every
+process after that dlopens the cached .so. Falls back cleanly (callers
+check ``ring_native() is None``) when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from typing import Optional
+
+_SRC_DIR = os.path.dirname(__file__)
+_lock = threading.Lock()
+_ring_mod = None
+_ring_tried = False
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("RAY_TPU_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "ray_tpu_native"
+    )
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _build(mod_name: str, src_name: str) -> Optional[str]:
+    """Compile src under _native/ into the cache; return the .so path."""
+    src = os.path.join(_SRC_DIR, src_name)
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(
+            f.read() + sys.version.encode()
+        ).hexdigest()[:16]
+    so_path = os.path.join(_cache_dir(), f"{mod_name}_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    include = sysconfig.get_paths()["include"]
+    tmp = so_path + f".tmp.{os.getpid()}"
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        f"-I{include}",
+        src,
+        "-o",
+        tmp,
+        "-lrt",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+        return so_path
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load(mod_name: str, so_path: str):
+    spec = importlib.util.spec_from_file_location(mod_name, so_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def ring_native():
+    """The _ring_native extension module, or None when unavailable."""
+    global _ring_mod, _ring_tried
+    if _ring_tried:
+        return _ring_mod
+    with _lock:
+        if _ring_tried:
+            return _ring_mod
+        if os.environ.get("RAY_TPU_DISABLE_NATIVE"):
+            _ring_tried = True
+            return None
+        so_path = _build("_ring_native", "ring_channel.cpp")
+        if so_path is not None:
+            try:
+                _ring_mod = _load("_ring_native", so_path)
+            except Exception:
+                _ring_mod = None
+        _ring_tried = True
+        return _ring_mod

@@ -4,7 +4,12 @@ jax.devices() initializes the PJRT plugin; on a tunneled TPU (axon)
 that can block for minutes when the tunnel is wedged. Nothing in the
 control plane is allowed to hang on accelerator discovery, so the
 probe runs in a throwaway subprocess with a hard timeout unless a
-backend is already live in-process (then it's cheap and exact).
+backend is already live in-process (then it's cheap and exact). The
+timeout (RAY_TPU_DETECT_TIMEOUT, default 120s) must comfortably cover
+a healthy first TPU init (~20-40s).
+
+This is the single probe implementation — bench.py and init() both
+use it; keep it that way so the timeout semantics can't diverge.
 """
 
 from __future__ import annotations
@@ -12,42 +17,64 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
-from typing import Optional
+from typing import Optional, Tuple
 
-_cached: Optional[int] = None
+_cached: Optional[Tuple[str, int]] = None  # (platform, tpu_count)
 
 
-def safe_tpu_device_count() -> int:
-    """TPU/axon device count, never blocking longer than
-    RAY_TPU_DETECT_TIMEOUT (default 20s). Returns 0 on any failure."""
+def _timeout_s() -> float:
+    return float(os.environ.get("RAY_TPU_DETECT_TIMEOUT", "120"))
+
+
+def probe_accelerator() -> Tuple[str, int]:
+    """(platform of device 0, TPU/axon device count), without ever
+    blocking past the detect timeout. ("", 0) on any failure."""
     global _cached
     if _cached is not None:
         return _cached
-    if "jax" not in sys.modules:
-        _cached = 0
-        return 0
-    import jax
+    if "jax" in sys.modules:
+        import jax
 
-    if jax._src.xla_bridge._backends:
+        backends_live = False
         try:
-            _cached = sum(
-                1 for d in jax.devices() if d.platform in ("tpu", "axon")
-            )
-        except Exception:
-            _cached = 0
-        return _cached
+            backends_live = bool(jax._src.xla_bridge._backends)
+        except AttributeError:
+            pass  # private attr moved; fall through to the subprocess
+        if backends_live:
+            try:
+                devs = jax.devices()
+                _cached = (
+                    devs[0].platform if devs else "",
+                    sum(1 for d in devs if d.platform in ("tpu", "axon")),
+                )
+            except Exception:
+                _cached = ("", 0)
+            return _cached
     try:
         out = subprocess.run(
             [
                 sys.executable,
                 "-c",
-                "import jax; print(sum(1 for d in jax.devices()"
-                " if d.platform in ('tpu', 'axon')))",
+                "import jax; ds = jax.devices(); "
+                "print(ds[0].platform if ds else '', "
+                "sum(1 for d in ds if d.platform in ('tpu', 'axon')))",
             ],
             capture_output=True,
-            timeout=float(os.environ.get("RAY_TPU_DETECT_TIMEOUT", "20")),
+            timeout=_timeout_s(),
         )
-        _cached = int(out.stdout.strip() or 0)
+        platform, count = out.stdout.decode().split()
+        _cached = (platform, int(count))
     except Exception:
-        _cached = 0
+        _cached = ("", 0)
     return _cached
+
+
+def safe_tpu_device_count() -> int:
+    """TPU/axon device count; 0 on any failure. Never hangs."""
+    return probe_accelerator()[1]
+
+
+def reset_probe_cache() -> None:
+    """Drop the cached probe result (tests; tunnel recovery)."""
+    global _cached
+    _cached = None

@@ -177,7 +177,10 @@ class CompiledDAG:
             return self._edge_chans[key]
 
         def desc(ch: ShmChannel):
-            return (ch.name, ch.shape, str(ch.dtype), ch.capacity)
+            # backend travels in the descriptor: both endpoints must map
+            # the same segment layout (native C++ ring vs numpy ring)
+            return (ch.name, ch.shape, str(ch.dtype), ch.capacity, False,
+                    ch.backend)
 
         self._stop_chans: List[ShmChannel] = []
         self._loop_refs = []

@@ -27,6 +27,7 @@ from .dataset import (
     read_text,
 )
 from .datasource import Datasource, ReadTask
+from . import preprocessors
 
 __all__ = [
     "AbsMax", "ActorPoolStrategy", "AggregateFn", "Block", "BlockAccessor",

@@ -8,6 +8,12 @@ allocating/sealing a new object. Implementation: a ring of K slots in
 one multiprocessing.shared_memory segment, with per-slot sequence
 numbers for lock-free SPSC handoff (write seq = read seq + 1 protocol).
 
+Two backends behind one API, chosen at create time and pinned in the
+pickled descriptor: the C++ ring from ray_tpu/_native/ring_channel.cpp
+(default when the toolchain is available — real atomics, GIL-released
+microsecond waits, like the reference's C++ mutable-object channel) and
+this file's pure-numpy ring (fallback; 500us polling floor).
+
 Use between pinned actors (compiled-graph stages, data feeders):
   ch = ShmChannel.create(shape=(8, 1024), dtype="float32")
   # producer:  ch.write(arr)         (blocks when ring full)
@@ -35,12 +41,40 @@ class ShmChannel:
         dtype: str,
         capacity: int,
         _create: bool = False,
+        backend: Optional[str] = None,
     ):
         self.name = name
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
         self.capacity = capacity
         item_bytes = int(np.prod(self.shape)) * self.dtype.itemsize
+        # Backend is fixed at create time and travels in the pickled
+        # descriptor: both endpoints must agree on the segment layout.
+        # "native" = the C++ ring (_native/ring_channel.cpp): real
+        # acquire/release atomics + GIL-released microsecond waits;
+        # "py" = this file's numpy ring.
+        if backend is None:
+            from ray_tpu._native import ring_native
+
+            backend = "native" if ring_native() is not None else "py"
+        self.backend = backend
+        if backend == "native":
+            from ray_tpu._native import ring_native
+
+            mod = ring_native()
+            if mod is None:
+                raise RuntimeError(
+                    "channel was created with the native backend but this "
+                    "process could not build/load _ring_native"
+                )
+            self._mod = mod
+            self._item_bytes = item_bytes
+            if _create:
+                self._ring = mod.create("/" + name, item_bytes, capacity)
+            else:
+                self._ring = mod.attach("/" + name)
+            self._shm = None
+            return
         hdr_bytes = _HDR_SLOTS * np.dtype(_HDR_DTYPE).itemsize
         seq_bytes = capacity * np.dtype(_HDR_DTYPE).itemsize
         total = hdr_bytes + seq_bytes + capacity * item_bytes
@@ -76,20 +110,33 @@ class ShmChannel:
     # -- lifecycle -----------------------------------------------------
     @classmethod
     def create(
-        cls, shape: Tuple[int, ...], dtype: str = "float32", capacity: int = 2
+        cls,
+        shape: Tuple[int, ...],
+        dtype: str = "float32",
+        capacity: int = 2,
+        backend: Optional[str] = None,
     ) -> "ShmChannel":
         import uuid
 
         name = f"rt_ch_{uuid.uuid4().hex[:12]}"
-        return cls(name, shape, dtype, capacity, _create=True)
+        return cls(name, shape, dtype, capacity, _create=True, backend=backend)
 
     def __reduce__(self):
         return (
             ShmChannel,
-            (self.name, self.shape, str(self.dtype), self.capacity),
+            (self.name, self.shape, str(self.dtype), self.capacity, False,
+             self.backend),
         )
 
     def close(self, unlink: bool = False) -> None:
+        if self.backend == "native":
+            self._ring = None  # capsule destructor munmaps
+            if unlink:
+                try:
+                    self._mod.unlink("/" + self.name)
+                except OSError:
+                    pass
+            return
         # release numpy views before closing the mapping
         self._hdr = None
         self._slot_seq = None
@@ -119,6 +166,9 @@ class ShmChannel:
         arr = np.ascontiguousarray(arr, dtype=self.dtype)
         if arr.shape != self.shape:
             raise ValueError(f"channel expects shape {self.shape}, got {arr.shape}")
+        if self.backend == "native":
+            self._mod.write(self._ring, arr.data, float(timeout_s))
+            return
         deadline = time.monotonic() + timeout_s
         w = int(self._hdr[0])
         while w - int(self._hdr[1]) >= self.capacity:  # ring full
@@ -132,6 +182,10 @@ class ShmChannel:
 
     def read(self, timeout_s: float = 30.0) -> np.ndarray:
         """Copy the next item out; blocks until the writer publishes."""
+        if self.backend == "native":
+            out = np.empty(self.shape, self.dtype)
+            self._mod.read_into(self._ring, out.data, float(timeout_s))
+            return out
         deadline = time.monotonic() + timeout_s
         r = int(self._hdr[1])
         slot = r % self.capacity
@@ -144,6 +198,11 @@ class ShmChannel:
         return out
 
     def try_read(self) -> Optional[np.ndarray]:
+        if self.backend == "native":
+            out = np.empty(self.shape, self.dtype)
+            if self._mod.try_read_into(self._ring, out.data):
+                return out
+            return None
         r = int(self._hdr[1])
         if int(self._slot_seq[r % self.capacity]) != r + 1:
             return None

@@ -196,6 +196,9 @@ class ServeController:
             for name in list(self._replicas):
                 if name not in targets:
                     self._scale_to(name, None, 0)
+            for name in list(self._model_ids):
+                if name not in targets:
+                    del self._model_ids[name]
             # miss counters only for replicas that still exist (retired
             # generations would otherwise leak entries forever)
             live_rids = {

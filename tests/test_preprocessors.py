@@ -1,0 +1,92 @@
+"""Data preprocessors (reference: python/ray/data/preprocessors/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu.data as rd
+from ray_tpu.data.preprocessors import (
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    Preprocessor,
+    SimpleImputer,
+    StandardScaler,
+)
+
+
+def _items_ds(rows):
+    return rd.from_items(rows)
+
+
+def test_standard_scaler(ray_start_regular):
+    ds = _items_ds([{"x": float(i), "y": float(2 * i)} for i in range(10)])
+    scaler = StandardScaler(["x", "y"])
+    out = scaler.fit_transform(ds)
+    xs = np.concatenate([np.asarray(b["x"]) for b in out.iter_batches()])
+    assert abs(xs.mean()) < 1e-9
+    assert abs(xs.std(ddof=1) - 1.0) < 1e-6
+    # transform_batch on a raw dict works too
+    b = scaler.transform_batch({"x": np.asarray([4.5]), "y": np.asarray([9.0])})
+    assert abs(float(b["x"][0])) < 1e-9  # 4.5 is the mean of 0..9
+
+
+def test_min_max_scaler(ray_start_regular):
+    ds = _items_ds([{"x": float(i)} for i in range(5)])
+    out = MinMaxScaler(["x"]).fit_transform(ds)
+    xs = sorted(float(r["x"]) for r in out.iter_rows())
+    assert xs[0] == 0.0 and xs[-1] == 1.0
+
+
+def test_one_hot_encoder(ray_start_regular):
+    ds = _items_ds([{"c": v} for v in ["a", "b", "a", "c"]])
+    enc = OneHotEncoder(["c"]).fit(ds)
+    out = enc.transform(ds)
+    rows = list(out.iter_rows())
+    assert set(rows[0].keys()) == {"c_a", "c_b", "c_c"}
+    assert rows[0]["c_a"] == 1 and rows[0]["c_b"] == 0
+    totals = {k: sum(r[k] for r in rows) for k in rows[0]}
+    assert totals == {"c_a": 2, "c_b": 1, "c_c": 1}
+
+
+def test_label_encoder_and_unseen(ray_start_regular):
+    ds = _items_ds([{"label": v} for v in ["dog", "cat", "dog", "fish"]])
+    enc = LabelEncoder("label").fit(ds)
+    out = enc.transform(ds)
+    labels = [int(r["label"]) for r in out.iter_rows()]
+    assert sorted(set(labels)) == [0, 1, 2]
+    with pytest.raises(ValueError, match="unseen"):
+        enc.transform_batch({"label": np.asarray(["wolf"])})
+
+
+def test_simple_imputer_mean(ray_start_regular):
+    ds = _items_ds([{"x": 1.0}, {"x": float("nan")}, {"x": 3.0}])
+    out = SimpleImputer(["x"], strategy="mean").fit_transform(ds)
+    xs = sorted(float(r["x"]) for r in out.iter_rows())
+    assert xs == [1.0, 2.0, 3.0]
+
+
+def test_concatenator(ray_start_regular):
+    ds = _items_ds([{"a": 1.0, "b": 2.0}, {"a": 3.0, "b": 4.0}])
+    out = Concatenator(["a", "b"], output_column_name="feat").fit_transform(ds)
+    batches = list(out.iter_batches())
+    feat = np.concatenate([np.asarray(b["feat"]) for b in batches])
+    assert feat.shape == (2, 2)
+    assert feat.dtype == np.float32
+
+
+def test_chain_scales_then_concats(ray_start_regular):
+    ds = _items_ds([{"a": float(i), "b": float(i * 10)} for i in range(8)])
+    chain = Chain(StandardScaler(["a", "b"]), Concatenator(["a", "b"]))
+    out = chain.fit_transform(ds)
+    feat = np.concatenate(
+        [np.asarray(b["concat_out"]) for b in out.iter_batches()]
+    )
+    assert feat.shape == (8, 2)
+    assert abs(feat[:, 0].mean()) < 1e-6
+
+
+def test_unfitted_raises(ray_start_regular):
+    with pytest.raises(RuntimeError, match="must be fit"):
+        StandardScaler(["x"]).transform_batch({"x": np.asarray([1.0])})
