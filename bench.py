@@ -45,9 +45,14 @@ def _ensure_live_backend() -> None:
     hanging the whole bench run."""
     import sys as _sys
 
+    import os
+
     from ray_tpu._private.jax_utils import probe_accelerator
 
-    platform, _ = probe_accelerator()
+    platform, _ = probe_accelerator(
+        timeout_s=float(os.environ.get("RAY_TPU_BENCH_PROBE_TIMEOUT", "120")),
+        force=True,
+    )
     if platform in ("tpu", "axon"):
         return
     import jax

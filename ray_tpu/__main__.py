@@ -1,0 +1,3 @@
+from ray_tpu.scripts import main
+
+main()

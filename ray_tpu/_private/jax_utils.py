@@ -5,8 +5,9 @@ that can block for minutes when the tunnel is wedged. Nothing in the
 control plane is allowed to hang on accelerator discovery, so the
 probe runs in a throwaway subprocess with a hard timeout unless a
 backend is already live in-process (then it's cheap and exact). The
-timeout (RAY_TPU_DETECT_TIMEOUT, default 120s) must comfortably cover
-a healthy first TPU init (~20-40s).
+default timeout (RAY_TPU_DETECT_TIMEOUT, 20s) keeps init() snappy on a
+wedged tunnel; accelerator-seeking callers (bench.py) pass a longer
+one that covers a healthy first TPU init (~20-40s).
 
 This is the single probe implementation — bench.py and init() both
 use it; keep it that way so the timeout semantics can't diverge.
@@ -22,16 +23,23 @@ from typing import Optional, Tuple
 _cached: Optional[Tuple[str, int]] = None  # (platform, tpu_count)
 
 
-def _timeout_s() -> float:
-    return float(os.environ.get("RAY_TPU_DETECT_TIMEOUT", "120"))
-
-
-def probe_accelerator() -> Tuple[str, int]:
+def probe_accelerator(
+    timeout_s: Optional[float] = None, force: bool = False
+) -> Tuple[str, int]:
     """(platform of device 0, TPU/axon device count), without ever
-    blocking past the detect timeout. ("", 0) on any failure."""
+    blocking past the timeout. ("", 0) on any failure.
+
+    Without ``force``, returns ("", 0) instantly when jax was never
+    imported in this process — a CPU-only init() must not pay a
+    subprocess jax import. Callers that exist to find an accelerator
+    (bench.py) pass force=True and a generous timeout that covers first
+    TPU init (~20-40s).
+    """
     global _cached
     if _cached is not None:
         return _cached
+    if not force and "jax" not in sys.modules:
+        return ("", 0)  # not cached: a later forced probe may differ
     if "jax" in sys.modules:
         import jax
 
@@ -50,6 +58,8 @@ def probe_accelerator() -> Tuple[str, int]:
             except Exception:
                 _cached = ("", 0)
             return _cached
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("RAY_TPU_DETECT_TIMEOUT", "20"))
     try:
         out = subprocess.run(
             [
@@ -60,7 +70,7 @@ def probe_accelerator() -> Tuple[str, int]:
                 "sum(1 for d in ds if d.platform in ('tpu', 'axon')))",
             ],
             capture_output=True,
-            timeout=_timeout_s(),
+            timeout=timeout_s,
         )
         platform, count = out.stdout.decode().split()
         _cached = (platform, int(count))
@@ -70,7 +80,8 @@ def probe_accelerator() -> Tuple[str, int]:
 
 
 def safe_tpu_device_count() -> int:
-    """TPU/axon device count; 0 on any failure. Never hangs."""
+    """TPU/axon device count; 0 on any failure. Never hangs, and free
+    when jax was never imported in this process."""
     return probe_accelerator()[1]
 
 

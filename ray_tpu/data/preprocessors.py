@@ -112,10 +112,10 @@ def _collect_uniques(ds: Dataset, columns: List[str]) -> Dict[str, np.ndarray]:
     """One pass: per-block uniques, unioned on the driver."""
 
     def block_uniques(batch):
-        n = max(len(np.unique(batch[c])) for c in columns)
+        uniques = {c: np.unique(batch[c]) for c in columns}
+        n = max(len(u) for u in uniques.values())
         out = {}
-        for c in columns:
-            u = np.unique(batch[c])
+        for c, u in uniques.items():
             # pad so all columns align into one rectangular block
             pad = np.full(n - len(u), u[-1] if len(u) else 0, dtype=u.dtype)
             out["u_" + c] = np.concatenate([u, pad]) if len(u) else u

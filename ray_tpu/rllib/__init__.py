@@ -6,6 +6,7 @@ PPO (sync batch) + IMPALA (async actor-learner with V-trace, §2.5).
 """
 
 from .algorithm import Algorithm
+from .appo import APPO, APPOConfig
 from .bc import BC, BCConfig
 from .core import MLPSpec, forward, init_mlp_module, sample_actions
 from .env_runner import SingleAgentEnvRunner
@@ -13,9 +14,12 @@ from .dqn import DQN, DQNConfig
 from .impala import IMPALA, IMPALAConfig, vtrace
 from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .ppo import PPOConfig
+from .sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm",
+    "APPO",
+    "APPOConfig",
     "BC",
     "BCConfig",
     "DQN",
@@ -24,6 +28,8 @@ __all__ = [
     "IMPALAConfig",
     "MLPSpec",
     "PPOConfig",
+    "SAC",
+    "SACConfig",
     "SingleAgentEnvRunner",
     "forward",
     "init_mlp_module",

@@ -1,0 +1,105 @@
+"""APPO: asynchronous PPO — IMPALA's actor-learner with PPO's clipped
+surrogate.
+
+Parity: python/ray/rllib/algorithms/appo/ — same async sampling
+architecture as IMPALA (stale behavior policies, V-trace correction)
+but the policy loss is the PPO clipped surrogate over the V-trace
+advantages, which tolerates more staleness than the plain V-trace
+policy-gradient. Reuses IMPALA's runner fan-out and jit shape; only
+the compiled loss differs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .core import MLPSpec, forward
+from .impala import IMPALA, IMPALAConfig, vtrace
+
+_UPDATE_CACHE: dict = {}
+
+
+@dataclass
+class APPOConfig(IMPALAConfig):
+    """Builder (reference: appo.py APPOConfig — clip_param on top of the
+    IMPALA knobs)."""
+
+    clip_param: float = 0.3
+
+    def build_algo(self):
+        return APPO(self)
+
+    build = build_algo
+
+
+def make_appo_update(config: APPOConfig, spec: MLPSpec):
+    import optax
+
+    key = (
+        config.lr, config.gamma, config.vtrace_clip_rho,
+        config.vtrace_clip_c, config.vf_loss_coeff, config.entropy_coeff,
+        config.grad_clip, config.clip_param, spec,
+    )
+    cached = _UPDATE_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(config.grad_clip),
+        optax.adam(config.lr),
+    )
+
+    def loss_fn(params, batch):
+        logits, values = forward(params, batch["obs"])  # (T, B, A), (T, B)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, batch["actions"][..., None], axis=-1
+        )[..., 0]
+        bootstrap = forward(params, batch["final_obs"])[1]
+        vs, pg_adv = vtrace(
+            batch["logp_mu"], jax.lax.stop_gradient(logp),
+            batch["rewards"], batch["dones"],
+            jax.lax.stop_gradient(values), jax.lax.stop_gradient(bootstrap),
+            gamma=config.gamma,
+            clip_rho=config.vtrace_clip_rho,
+            clip_c=config.vtrace_clip_c,
+        )
+        adv = jax.lax.stop_gradient(pg_adv)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        # PPO clipped surrogate against the BEHAVIOR policy (the APPO
+        # twist: ratio is new-policy vs rollout-time policy)
+        ratio = jnp.exp(logp - batch["logp_mu"])
+        clipped = jnp.clip(ratio, 1 - config.clip_param, 1 + config.clip_param)
+        pi_loss = -jnp.mean(jnp.minimum(ratio * adv, clipped * adv))
+        vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = (
+            pi_loss
+            + config.vf_loss_coeff * vf_loss
+            - config.entropy_coeff * entropy
+        )
+        return total, {
+            "policy_loss": pi_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_ratio": jnp.mean(jax.lax.stop_gradient(ratio)),
+        }
+
+    @jax.jit
+    def update(params, opt_state, batch):
+        (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    _UPDATE_CACHE[key] = (optimizer, update)
+    return optimizer, update
+
+
+class APPO(IMPALA):
+    _make_update = staticmethod(make_appo_update)

@@ -192,11 +192,23 @@ class _EpsilonGreedyRunner:
             next_obs, rewards, term, trunc, infos = self.envs.step(actions)
             # time-limit truncation is not termination for bootstrapping
             done_for_target = np.asarray(term, np.float32)
+            # SAME_STEP autoreset: at done steps next_obs is the NEW
+            # episode's reset obs; store the true final obs so replayed
+            # truncation steps bootstrap the right state
+            next_store = next_obs
+            final_obs = infos.get("final_obs")
+            if final_obs is not None:
+                done_idx = np.nonzero(np.logical_or(term, trunc))[0]
+                if len(done_idx):
+                    next_store = next_obs.copy()
+                    for i in done_idx:
+                        if final_obs[i] is not None:
+                            next_store[i] = np.asarray(final_obs[i])
             sl = slice(t * N, (t + 1) * N)
             out["obs"][sl] = obs.reshape(N, -1)
             out["actions"][sl] = actions
             out["rewards"][sl] = rewards
-            out["next_obs"][sl] = next_obs.reshape(N, -1)
+            out["next_obs"][sl] = next_store.reshape(N, -1)
             out["dones"][sl] = done_for_target
             self._ep_returns += rewards
             for i in np.nonzero(np.logical_or(term, trunc))[0]:
@@ -247,15 +259,17 @@ class DQN:
         ray = self._ray
         c = self.config
         host_params = jax.tree.map(np.asarray, self.params)
-        episode_returns: list = []
+        # per-runner latest last-100 window (cumulative per runner):
+        # keep the newest per runner, concat across runners
+        latest_windows: Dict[int, list] = {}
         loss_val = float("nan")
         for _ in range(c.updates_per_iteration):
             rollouts = ray.get([
                 r.sample.remote(host_params, self._epsilon())
                 for r in self.env_runners
             ])
-            for ro in rollouts:
-                episode_returns = ro.pop("episode_returns").tolist()
+            for idx, ro in enumerate(rollouts):
+                latest_windows[idx] = ro.pop("episode_returns").tolist()
                 self.buffer.add(ro)
                 self._timesteps += len(ro["actions"])
             if (
@@ -273,6 +287,7 @@ class DQN:
                 if self._updates % c.target_network_update_freq == 0:
                     self.target_params = jax.tree.map(lambda x: x, self.params)
             host_params = jax.tree.map(np.asarray, self.params)
+        episode_returns = [r for w in latest_windows.values() for r in w]
         self.iteration += 1
         return {
             "training_iteration": self.iteration,

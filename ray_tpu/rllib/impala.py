@@ -205,7 +205,7 @@ class IMPALA:
         num_actions = ray_tpu.get(self.env_runners[0].num_actions.remote())
         self.spec = MLPSpec(obs_dim, num_actions, tuple(config.hiddens))
         self.params = init_mlp_module(jax.random.PRNGKey(config.seed), self.spec)
-        self.optimizer, self._update = make_impala_update(config, self.spec)
+        self.optimizer, self._update = self._make_update(config, self.spec)
         self.opt_state = self.optimizer.init(self.params)
         self.iteration = 0
         self._timesteps = 0
@@ -213,6 +213,10 @@ class IMPALA:
         # async pipeline: every runner always has a sample() in flight
         self._inflight: Dict[Any, int] = {}
         self._np = np
+
+    # subclass hook: APPO swaps in the clipped-surrogate learner while
+    # keeping the whole async actor-learner machinery
+    _make_update = staticmethod(make_impala_update)
 
     def _host_params(self):
         return jax.tree.map(self._np.asarray, self.params)

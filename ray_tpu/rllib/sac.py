@@ -1,0 +1,437 @@
+"""SAC: off-policy maximum-entropy actor-critic for continuous control.
+
+Parity: python/ray/rllib/algorithms/sac/ (twin critics, tanh-squashed
+Gaussian policy, automatic entropy-coefficient tuning against
+target_entropy=-|A|). TPU-native: the entire update — twin-critic
+Bellman step, reparameterized actor step, alpha step, and the polyak
+target sync — is ONE jitted program; rollout actors sample with a
+jitted policy forward and ship flat numpy transitions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .replay_buffers import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+@dataclass
+class SACConfig:
+    env: Optional[Union[str, Callable]] = None
+    num_env_runners: int = 1
+    num_envs_per_env_runner: int = 2
+    rollout_fragment_length: int = 32
+    actor_lr: float = 3e-4
+    critic_lr: float = 3e-4
+    alpha_lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.005  # polyak target rate
+    initial_alpha: float = 1.0
+    target_entropy: Optional[float] = None  # default -action_dim
+    buffer_capacity: int = 100_000
+    train_batch_size: int = 256
+    num_steps_sampled_before_learning_starts: int = 1000
+    updates_per_iteration: int = 16  # sample rounds per train()
+    train_intensity: int = 8  # gradient updates per sample round
+    hiddens: Tuple[int, ...] = (256, 256)
+    seed: int = 0
+
+    def environment(self, env) -> "SACConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None) -> "SACConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "SACConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown SAC training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed=None) -> "SACConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def build_algo(self) -> "SAC":
+        return SAC(self)
+
+    build = build_algo
+
+
+# ------------------------------------------------------------------ nets
+def _dense(key, fan_in, fan_out, gain=1.0):
+    w = jax.nn.initializers.orthogonal(gain)(key, (fan_in, fan_out))
+    return {"w": w, "b": jnp.zeros((fan_out,))}
+
+
+def _mlp_init(key, sizes, out_dim, out_gain):
+    keys = jax.random.split(key, len(sizes) + 1)
+    layers = []
+    fan_in = sizes[0]
+    for i, h in enumerate(sizes[1:]):
+        layers.append(_dense(keys[i], fan_in, h, np.sqrt(2.0)))
+        fan_in = h
+    return {"torso": layers, "head": _dense(keys[-1], fan_in, out_dim, out_gain)}
+
+
+def _mlp_apply(params, x):
+    for layer in params["torso"]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def init_sac_params(rng, obs_dim: int, act_dim: int, hiddens) -> Dict[str, Any]:
+    k_pi, k_q1, k_q2 = jax.random.split(rng, 3)
+    sizes = (obs_dim, *hiddens)
+    q_sizes = (obs_dim + act_dim, *hiddens)
+    return {
+        "pi": _mlp_init(k_pi, sizes, 2 * act_dim, 0.01),  # mean ++ log_std
+        "q1": _mlp_init(k_q1, q_sizes, 1, 1.0),
+        "q2": _mlp_init(k_q2, q_sizes, 1, 1.0),
+    }
+
+
+def _policy_dist(pi_params, obs):
+    out = _mlp_apply(pi_params, obs)
+    mean, log_std = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+
+def _sample_squashed(pi_params, obs, rng):
+    """Reparameterized tanh-Gaussian sample -> (action in [-1,1], logp)."""
+    mean, log_std = _policy_dist(pi_params, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    act = jnp.tanh(pre)
+    # log prob with tanh change-of-variables (stable form)
+    logp = (
+        -0.5 * (eps**2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        - 2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre))
+    ).sum(-1)
+    return act, logp
+
+
+def _q(params, obs, act):
+    x = jnp.concatenate([obs, act], axis=-1)
+    return _mlp_apply(params, x)[..., 0]
+
+
+@jax.jit
+def sample_actions(pi_params, obs, rng):
+    act, _ = _sample_squashed(pi_params, obs, rng)
+    return act
+
+
+@jax.jit
+def deterministic_actions(pi_params, obs):
+    mean, _ = _policy_dist(pi_params, obs)
+    return jnp.tanh(mean)
+
+
+_UPDATE_CACHE: dict = {}
+
+
+def make_sac_update(config: SACConfig, act_dim: int):
+    import optax
+
+    key = (config.actor_lr, config.critic_lr, config.alpha_lr, config.gamma,
+           config.tau, act_dim, tuple(config.hiddens))
+    cached = _UPDATE_CACHE.get(key)
+    if cached is not None:
+        return cached
+    target_entropy = (
+        config.target_entropy
+        if config.target_entropy is not None
+        else -float(act_dim)
+    )
+    actor_opt = optax.adam(config.actor_lr)
+    critic_opt = optax.adam(config.critic_lr)
+    alpha_opt = optax.adam(config.alpha_lr)
+
+    def critic_loss_fn(q_params, pi_params, target_q, log_alpha, batch, rng):
+        next_act, next_logp = _sample_squashed(pi_params, batch["next_obs"], rng)
+        q_next = jnp.minimum(
+            _q(target_q["q1"], batch["next_obs"], next_act),
+            _q(target_q["q2"], batch["next_obs"], next_act),
+        )
+        alpha = jnp.exp(log_alpha)
+        target = batch["rewards"] + config.gamma * (1.0 - batch["dones"]) * (
+            q_next - alpha * next_logp
+        )
+        target = jax.lax.stop_gradient(target)
+        l1 = jnp.mean((_q(q_params["q1"], batch["obs"], batch["actions"]) - target) ** 2)
+        l2 = jnp.mean((_q(q_params["q2"], batch["obs"], batch["actions"]) - target) ** 2)
+        return l1 + l2
+
+    def actor_loss_fn(pi_params, q_params, log_alpha, batch, rng):
+        act, logp = _sample_squashed(pi_params, batch["obs"], rng)
+        q = jnp.minimum(
+            _q(q_params["q1"], batch["obs"], act),
+            _q(q_params["q2"], batch["obs"], act),
+        )
+        alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+        return jnp.mean(alpha * logp - q), logp
+
+    @jax.jit
+    def update(state, batch, rng):
+        (params, target_q, log_alpha, opt_states) = state
+        k1, k2 = jax.random.split(rng)
+        q_params = {"q1": params["q1"], "q2": params["q2"]}
+        closs, q_grads = jax.value_and_grad(critic_loss_fn)(
+            q_params, params["pi"], target_q, log_alpha, batch, k1
+        )
+        q_updates, critic_os = critic_opt.update(
+            q_grads, opt_states["critic"], q_params
+        )
+        q_params = optax.apply_updates(q_params, q_updates)
+
+        (aloss, logp), pi_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(params["pi"], q_params, log_alpha, batch, k2)
+        pi_updates, actor_os = actor_opt.update(
+            pi_grads, opt_states["actor"], params["pi"]
+        )
+        pi_params = optax.apply_updates(params["pi"], pi_updates)
+
+        # alpha step: match policy entropy to the target. Loss is
+        # -log_alpha * E[logp + H_target]; its grad wrt log_alpha is
+        # -E[gap]: entropy below target (gap > 0) drives log_alpha UP.
+        entropy_gap = jax.lax.stop_gradient(logp + target_entropy)
+        alpha_grad = -jnp.mean(entropy_gap)
+        alpha_updates, alpha_os = alpha_opt.update(
+            alpha_grad, opt_states["alpha"], log_alpha
+        )
+        log_alpha = optax.apply_updates(log_alpha, alpha_updates)
+
+        # polyak target sync inside the same compiled program
+        target_q = jax.tree.map(
+            lambda t, s: (1 - config.tau) * t + config.tau * s,
+            target_q,
+            q_params,
+        )
+        new_params = {"pi": pi_params, "q1": q_params["q1"], "q2": q_params["q2"]}
+        new_os = {"critic": critic_os, "actor": actor_os, "alpha": alpha_os}
+        return (new_params, target_q, log_alpha, new_os), closs, aloss
+
+    cached = (actor_opt, critic_opt, alpha_opt, update)
+    _UPDATE_CACHE[key] = cached
+    return cached
+
+
+# ------------------------------------------------------------------ runner
+class _GaussianRunner:
+    """Rollout actor for continuous spaces: tanh-Gaussian exploration,
+    actions stored normalized to [-1,1] (env sees the rescaled value)."""
+
+    def __init__(self, env_creator, num_envs, seed, fragment):
+        import gymnasium as gym
+
+        if isinstance(env_creator, str):
+            env_id = env_creator
+            fns = [lambda: gym.make(env_id) for _ in range(num_envs)]
+        else:
+            fns = [env_creator for _ in range(num_envs)]
+        self.envs = gym.vector.SyncVectorEnv(
+            fns, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP
+        )
+        space = self.envs.single_action_space
+        self.low = np.asarray(space.low, np.float32)
+        self.high = np.asarray(space.high, np.float32)
+        self.num_envs = num_envs
+        self.fragment = fragment
+        self.seed = seed
+        self._step = 0
+        self.obs, _ = self.envs.reset(seed=seed)
+        self._ep_returns = np.zeros(num_envs)
+        self.completed: list = []
+
+    def space_dims(self):
+        return (
+            int(np.prod(self.envs.single_observation_space.shape)),
+            int(np.prod(self.envs.single_action_space.shape)),
+        )
+
+    def action_bounds(self):
+        return self.low, self.high
+
+    def _to_env(self, act_norm):
+        return self.low + (act_norm + 1.0) * 0.5 * (self.high - self.low)
+
+    def sample(self, pi_params, random_actions: bool = False):
+        T, N = self.fragment, self.num_envs
+        obs_dim, act_dim = self.space_dims()
+        out = {
+            "obs": np.zeros((T * N, obs_dim), np.float32),
+            "actions": np.zeros((T * N, act_dim), np.float32),
+            "rewards": np.zeros((T * N,), np.float32),
+            "next_obs": np.zeros((T * N, obs_dim), np.float32),
+            "dones": np.zeros((T * N,), np.float32),
+        }
+        rng = np.random.default_rng(self.seed + self._step)
+        obs = self.obs
+        for t in range(T):
+            if random_actions:
+                act = rng.uniform(-1.0, 1.0, size=(N, act_dim)).astype(np.float32)
+            else:
+                key = jax.random.PRNGKey(self.seed * 100003 + self._step)
+                act = np.asarray(
+                    sample_actions(pi_params, jnp.asarray(obs, jnp.float32), key)
+                )
+            self._step += 1
+            next_obs, rewards, term, trunc, infos = self.envs.step(self._to_env(act))
+            # SAME_STEP autoreset returns the NEW episode's reset obs at
+            # done steps; the transition must store the true final obs
+            # (infos["final_obs"]) or the critic bootstraps into an
+            # unrelated state on every truncation
+            next_store = next_obs
+            final_obs = infos.get("final_obs")
+            if final_obs is not None:
+                done_idx = np.nonzero(np.logical_or(term, trunc))[0]
+                if len(done_idx):
+                    next_store = next_obs.copy()
+                    for i in done_idx:
+                        if final_obs[i] is not None:
+                            next_store[i] = np.asarray(final_obs[i])
+            sl = slice(t * N, (t + 1) * N)
+            out["obs"][sl] = obs.reshape(N, -1)
+            out["actions"][sl] = act
+            out["rewards"][sl] = rewards
+            out["next_obs"][sl] = next_store.reshape(N, -1)
+            out["dones"][sl] = np.asarray(term, np.float32)
+            self._ep_returns += rewards
+            for i in np.nonzero(np.logical_or(term, trunc))[0]:
+                self.completed.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            obs = next_obs
+        self.obs = obs
+        out["episode_returns"] = np.asarray(self.completed[-100:], np.float32)
+        return out
+
+
+# ------------------------------------------------------------------ algo
+class SAC:
+    def __init__(self, config: SACConfig):
+        import ray_tpu
+
+        if config.env is None:
+            raise ValueError("config.environment(env) is required")
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self.config = config
+        self._ray = ray_tpu
+        runner_cls = ray_tpu.remote(_GaussianRunner)
+        self.env_runners = [
+            runner_cls.remote(
+                config.env, config.num_envs_per_env_runner,
+                config.seed + 1000 * i, config.rollout_fragment_length,
+            )
+            for i in range(config.num_env_runners)
+        ]
+        obs_dim, act_dim = ray_tpu.get(self.env_runners[0].space_dims.remote())
+        self.act_dim = act_dim
+        self.action_low, self.action_high = ray_tpu.get(
+            self.env_runners[0].action_bounds.remote()
+        )
+        self.params = init_sac_params(
+            jax.random.PRNGKey(config.seed), obs_dim, act_dim, config.hiddens
+        )
+        self.target_q = jax.tree.map(
+            lambda x: x, {"q1": self.params["q1"], "q2": self.params["q2"]}
+        )
+        self.log_alpha = jnp.asarray(np.log(config.initial_alpha), jnp.float32)
+        actor_opt, critic_opt, alpha_opt, self._update = make_sac_update(
+            config, act_dim
+        )
+        self.opt_states = {
+            "critic": critic_opt.init(
+                {"q1": self.params["q1"], "q2": self.params["q2"]}
+            ),
+            "actor": actor_opt.init(self.params["pi"]),
+            "alpha": alpha_opt.init(self.log_alpha),
+        }
+        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.iteration = 0
+        self._timesteps = 0
+        self._rng = jax.random.PRNGKey(config.seed + 777)
+
+    def train(self) -> Dict[str, Any]:
+        ray = self._ray
+        c = self.config
+        host_pi = jax.tree.map(np.asarray, self.params["pi"])
+        # per-runner latest last-100 window (windows are cumulative per
+        # runner, so keep only the newest per runner and concat across
+        # runners — extending every round would double-count episodes)
+        latest_windows: Dict[int, list] = {}
+        closs = aloss = float("nan")
+        for _ in range(c.updates_per_iteration):
+            warmup = self._timesteps < c.num_steps_sampled_before_learning_starts
+            rollouts = ray.get([
+                r.sample.remote(host_pi, warmup) for r in self.env_runners
+            ])
+            for idx, ro in enumerate(rollouts):
+                latest_windows[idx] = ro.pop("episode_returns").tolist()
+                self.buffer.add(ro)
+                self._timesteps += len(ro["actions"])
+            if warmup or len(self.buffer) < c.train_batch_size:
+                continue
+            state = (self.params, self.target_q, self.log_alpha, self.opt_states)
+            for _ in range(c.train_intensity):
+                batch = self.buffer.sample(c.train_batch_size)
+                self._rng, k = jax.random.split(self._rng)
+                state, cl, al = self._update(state, batch, k)
+                closs, aloss = float(cl), float(al)
+            (self.params, self.target_q, self.log_alpha, self.opt_states) = state
+            host_pi = jax.tree.map(np.asarray, self.params["pi"])
+        episode_returns = [r for w in latest_windows.values() for r in w]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled_lifetime": self._timesteps,
+            "episode_return_mean": (
+                float(np.mean(episode_returns)) if episode_returns
+                else float("nan")
+            ),
+            "num_episodes": len(episode_returns),
+            "critic_loss": closs,
+            "actor_loss": aloss,
+            "alpha": float(jnp.exp(self.log_alpha)),
+            "buffer_size": len(self.buffer),
+        }
+
+    def compute_single_action(self, obs) -> np.ndarray:
+        """Deterministic ENV-SPACE action (the runner applies the same
+        rescale before env.step; RLlib returns env-space actions too)."""
+        act = np.asarray(
+            deterministic_actions(
+                self.params["pi"], jnp.asarray(obs, jnp.float32)[None]
+            )[0]
+        )
+        return self.action_low + (act + 1.0) * 0.5 * (
+            self.action_high - self.action_low
+        )
+
+    def stop(self) -> None:
+        for r in self.env_runners:
+            try:
+                self._ray.kill(r)
+            except Exception:
+                pass
+        self.env_runners = []

@@ -1,0 +1,359 @@
+"""`python -m ray_tpu` — the cluster CLI.
+
+Parity: the reference's `ray` CLI (python/ray/scripts/scripts.py:
+start/stop/status/timeline/memory/debug), the state CLI
+(`ray list ...`, python/ray/util/state/state_cli.py) and the job CLI
+(`ray job submit/status/logs/stop/list`,
+python/ray/dashboard/modules/job/cli.py). One argparse tree, no
+external CLI framework.
+
+    python -m ray_tpu start --head --port 7777        # head (blocks)
+    python -m ray_tpu start --address tcp://ip:7777   # join as a node
+    python -m ray_tpu status
+    python -m ray_tpu list actors
+    python -m ray_tpu summary tasks
+    python -m ray_tpu timeline --output /tmp/tl.json
+    python -m ray_tpu memory
+    python -m ray_tpu job submit -- python train.py
+    python -m ray_tpu job logs <id>
+    python -m ray_tpu debug
+    python -m ray_tpu stop
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+_STATE_DIR = os.path.join(os.path.expanduser("~"), ".ray_tpu")
+_ADDR_FILE = os.path.join(_STATE_DIR, "head_address")
+_PID_FILE = os.path.join(_STATE_DIR, "head_pid")
+
+
+# ------------------------------------------------------------------ helpers
+def _resolve_address(explicit: Optional[str]) -> Optional[str]:
+    if explicit:
+        return explicit
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    try:
+        with open(_ADDR_FILE) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+def _connect(args) -> None:
+    import ray_tpu
+
+    addr = _resolve_address(getattr(args, "address", None))
+    if addr is None:
+        raise SystemExit(
+            "no cluster address: pass --address, set RAY_TPU_ADDRESS, or "
+            "run `python -m ray_tpu start --head` first"
+        )
+    ray_tpu.init(address=addr, ignore_reinit_error=True)
+
+
+def _print_table(rows: List[dict], columns: List[str]) -> None:
+    if not rows:
+        print("(none)")
+        return
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ""))) for r in rows)) for c in columns
+    }
+    header = "  ".join(c.upper().ljust(widths[c]) for c in columns)
+    print(header)
+    print("-" * len(header))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c]) for c in columns))
+
+
+# ------------------------------------------------------------------ commands
+def cmd_start(args) -> None:
+    os.makedirs(_STATE_DIR, exist_ok=True)
+    if args.head:
+        import ray_tpu
+
+        ctx = ray_tpu.init(
+            num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus,
+            max_workers=args.max_workers,
+            _tcp_hub=True,
+            _hub_host=args.host,
+        )
+        addr = ctx.address_info["address"]
+        with open(_ADDR_FILE, "w") as f:
+            f.write(addr)
+        with open(_PID_FILE, "w") as f:
+            f.write(str(os.getpid()))
+        print(f"ray_tpu head started at {addr}")
+        print("connect with: ray_tpu.init(address=" + repr(addr) + ")")
+        print(f"stop with: python -m ray_tpu stop")
+        # Head blocks for its lifetime (reference: ray start --block; a
+        # non-blocking daemonizing head adds nothing on one host where
+        # drivers embed the hub in-process anyway).
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        return
+    # join an existing cluster as a node agent (reference: ray start
+    # --address=...)
+    addr = _resolve_address(args.address)
+    if addr is None:
+        raise SystemExit("start: need --head or --address tcp://host:port")
+    env = dict(os.environ)
+    env.update(
+        RAY_TPU_HUB_ADDR=addr,
+        RAY_TPU_NODE_ID=args.node_id or f"cli-node-{os.getpid()}",
+        RAY_TPU_NUM_CPUS=str(args.num_cpus or (os.cpu_count() or 1)),
+    )
+    if args.num_tpus is not None:
+        env["RAY_TPU_NUM_TPUS"] = str(args.num_tpus)
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "ray_tpu._private.node_agent"],
+        env,
+    )
+
+
+def cmd_stop(args) -> None:
+    try:
+        with open(_PID_FILE) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        raise SystemExit("no recorded head pid (was `start --head` used?)")
+    try:
+        os.kill(pid, signal.SIGINT)
+        print(f"sent SIGINT to head (pid {pid})")
+    except ProcessLookupError:
+        print("head already gone")
+    for path in (_PID_FILE, _ADDR_FILE):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+def cmd_status(args) -> None:
+    import ray_tpu
+
+    _connect(args)
+    nodes = ray_tpu.nodes()
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    print(f"nodes: {len(nodes)}")
+    _print_table(
+        [
+            {
+                "node_id": n["node_id"],
+                "alive": n["alive"],
+                "hostname": n.get("hostname", ""),
+                "cpu": n.get("resources", {}).get("CPU", 0),
+                "tpu": n.get("resources", {}).get("TPU", 0),
+            }
+            for n in nodes
+        ],
+        ["node_id", "alive", "hostname", "cpu", "tpu"],
+    )
+    print("\nresources (available / total):")
+    for key in sorted(total):
+        print(f"  {key}: {avail.get(key, 0):g} / {total[key]:g}")
+
+
+_LIST_COLUMNS = {
+    "actors": ["actor_id", "class_name", "state", "name", "pid"],
+    "tasks": ["task_id", "name", "state", "worker_id"],
+    "workers": ["worker_id", "node_id", "pid", "state"],
+    "nodes": ["node_id", "alive", "hostname"],
+    "objects": ["object_id", "size_bytes", "location"],
+    "placement_groups": ["pg_id", "state", "strategy"],
+}
+
+
+def cmd_list(args) -> None:
+    from ray_tpu.util import state as state_api
+
+    _connect(args)
+    kind = {"pgs": "placement_groups"}.get(args.kind, args.kind)
+    fn = getattr(state_api, f"list_{kind}")
+    rows = fn()
+    if args.format == "json":
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    cols = _LIST_COLUMNS.get(kind) or (list(rows[0].keys()) if rows else [])
+    _print_table(rows, cols)
+
+
+def cmd_summary(args) -> None:
+    from ray_tpu.util import state as state_api
+
+    _connect(args)
+    fn = getattr(state_api, f"summarize_{args.kind}")
+    print(json.dumps(fn(), indent=2, default=str))
+
+
+def cmd_timeline(args) -> None:
+    import ray_tpu
+
+    _connect(args)
+    events = ray_tpu.timeline()
+    out = args.output or "ray_tpu_timeline.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {out} (chrome://tracing format)")
+
+
+def cmd_memory(args) -> None:
+    from ray_tpu.util import state as state_api
+
+    _connect(args)
+    print(json.dumps(state_api.summarize_objects(), indent=2, default=str))
+
+
+def cmd_job(args) -> None:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    addr = _resolve_address(args.address)
+    if addr is None:
+        # without this guard JobSubmissionClient would silently boot a
+        # throwaway in-process cluster that dies when the CLI exits
+        raise SystemExit(
+            "no cluster address: pass --address, set RAY_TPU_ADDRESS, or "
+            "run `python -m ray_tpu start --head` first"
+        )
+    client = JobSubmissionClient(address=addr)
+    if args.job_cmd == "submit":
+        import shlex
+
+        # shlex.join: argv elements with spaces/parens must survive the
+        # shell the job supervisor execs the entrypoint with
+        entrypoint = shlex.join(args.entrypoint)
+        if not entrypoint:
+            raise SystemExit("job submit: pass the entrypoint after --")
+        job_id = client.submit_job(entrypoint=entrypoint)
+        print(job_id)
+        if args.wait:
+            status = client.wait_until_finished(job_id, timeout=args.timeout)
+            print(status)
+            sys.stdout.write(client.get_job_logs(job_id))
+            if status != "SUCCEEDED":
+                raise SystemExit(1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.job_cmd == "logs":
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    elif args.job_cmd == "list":
+        _print_table(
+            client.list_jobs(), ["submission_id", "status", "entrypoint"]
+        )
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.job_id))
+
+
+def cmd_debug(args) -> None:
+    from ray_tpu.util import rpdb
+
+    _connect(args)
+    bps = rpdb.list_breakpoints()
+    if not bps:
+        print("no active breakpoints")
+        return
+    for i, bp in enumerate(bps):
+        print(f"[{i}] {bp['uuid']} pid={bp['pid']} {bp['host']}:{bp['port']}")
+    choice = 0
+    if len(bps) > 1 and sys.stdin.isatty():
+        choice = int(input("attach to which breakpoint? ") or "0")
+    print(f"attaching to {bps[choice]['uuid']} (Ctrl-D to detach)")
+    rpdb.connect(bps[choice]["uuid"])
+
+
+# ------------------------------------------------------------------ parser
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add_address(sp):
+        sp.add_argument("--address", default=None, help="tcp://host:port")
+
+    sp = sub.add_parser("start", help="start a head or join as a node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--host", default="0.0.0.0")
+    sp.add_argument("--num-cpus", type=int, default=None)
+    sp.add_argument("--num-tpus", type=int, default=None)
+    sp.add_argument("--max-workers", type=int, default=None)
+    sp.add_argument("--node-id", default=None)
+    add_address(sp)
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the head started by this CLI")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster nodes + resources")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument(
+        "kind",
+        choices=["actors", "tasks", "workers", "nodes", "objects",
+                 "placement_groups", "pgs"],
+    )
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="aggregate state summaries")
+    sp.add_argument("kind", choices=["tasks", "actors", "objects"])
+    add_address(sp)
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="dump chrome://tracing timeline")
+    sp.add_argument("--output", default=None)
+    add_address(sp)
+    sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser("memory", help="object store summary")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    add_address(j)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+        add_address(j)
+    j = jsub.add_parser("list")
+    add_address(j)
+    sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser("debug", help="attach to a remote breakpoint")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_debug)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = _build_parser().parse_args(argv)
+    # strip a leading "--" from REMAINDER entrypoints
+    if getattr(args, "entrypoint", None) and args.entrypoint[0] == "--":
+        args.entrypoint = args.entrypoint[1:]
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

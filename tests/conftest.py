@@ -53,6 +53,11 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     )
 os.environ.setdefault("RAY_TPU_NUM_TPUS", "0")
+# XLA:CPU's AOT cache loader logs a full ERROR line per cached program
+# whose embedded "machine features" include XLA's own tuning pseudo-
+# features (+prefer-no-scatter/+prefer-no-gather) — harmless (it just
+# recompiles) but it floods test logs. 3 = fatal-only for TSL/XLA logs.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax
 

@@ -1,0 +1,95 @@
+"""CLI (`python -m ray_tpu ...`) — reference: ray start/status/list/job
+CLIs (python/ray/scripts/scripts.py, util/state/state_cli.py,
+dashboard/modules/job/cli.py)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.usefixtures("shutdown_only")
+
+
+@pytest.fixture
+def cli_cluster(tmp_path):
+    """A head started through the CLI in a subprocess, isolated HOME."""
+    env = dict(os.environ)
+    env["HOME"] = str(tmp_path)
+    env["RAY_TPU_NUM_TPUS"] = "0"
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start", "--head",
+         "--num-cpus", "2", "--host", "127.0.0.1"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    addr_file = tmp_path / ".ray_tpu" / "head_address"
+    deadline = time.monotonic() + 30
+    while not addr_file.exists():
+        assert head.poll() is None, head.stdout.read()
+        assert time.monotonic() < deadline, "head never wrote address file"
+        time.sleep(0.1)
+    yield env, addr_file.read_text().strip(), head
+    if head.poll() is None:
+        head.send_signal(signal.SIGINT)
+        try:
+            head.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            head.kill()
+
+
+def _cli(env, *argv, timeout=60):
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *argv],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    return out.stdout
+
+
+def test_status_and_list(cli_cluster):
+    env, addr, _head = cli_cluster
+    out = _cli(env, "status")
+    assert "nodes: 1" in out
+    assert "CPU" in out
+    out = _cli(env, "list", "nodes", "--format", "json")
+    nodes = json.loads(out)
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    out = _cli(env, "list", "actors")
+    assert "(none)" in out or "ACTOR_ID" in out
+
+
+def test_job_submit_wait_logs(cli_cluster):
+    env, addr, _head = cli_cluster
+    out = _cli(
+        env, "job", "submit", "--wait", "--",
+        sys.executable, "-c", "print('hello from job')",
+        timeout=120,
+    )
+    assert "SUCCEEDED" in out
+    assert "hello from job" in out
+
+
+def test_summary_and_timeline(cli_cluster, tmp_path):
+    env, addr, _head = cli_cluster
+    out = _cli(env, "summary", "tasks")
+    json.loads(out)
+    tl = tmp_path / "tl.json"
+    out = _cli(env, "timeline", "--output", str(tl))
+    assert tl.exists()
+    json.loads(tl.read_text())
+
+
+def test_stop_halts_head(cli_cluster):
+    env, addr, head = cli_cluster
+    _cli(env, "stop")
+    head.wait(timeout=15)
+    assert head.poll() is not None

@@ -81,7 +81,7 @@ def test_py_backend_forced_and_pinned():
 
         ch2 = pickle.loads(pickle.dumps(ch))
         assert ch2.backend == "py"
-        ch.write(np.arange(4, np.float32) if False else np.arange(4).astype(np.float32))
+        ch.write(np.arange(4, dtype=np.float32))
         assert ch2.read(timeout_s=5)[2] == 2.0
         ch2.close()
     finally:
