@@ -212,6 +212,7 @@ class Hub:
         worker_env: Optional[Dict[str, str]] = None,
         tcp: bool = False,
         host: str = "127.0.0.1",
+        port: int = 0,
         object_store_memory: Optional[float] = None,
     ):
         import socket as _socket
@@ -237,7 +238,7 @@ class Hub:
         if tcp:
             # Cluster mode: node agents and their workers dial in over
             # TCP (the AF_UNIX hub cannot leave the host — VERDICT r1).
-            self.listener = Listener((host, 0), family="AF_INET")
+            self.listener = Listener((host, port), family="AF_INET")
             lhost, lport = self.listener.address
             self.addr = f"tcp://{lhost}:{lport}"
         else:

@@ -21,6 +21,10 @@ import sys
 from typing import Optional, Tuple
 
 _cached: Optional[Tuple[str, int]] = None  # (platform, tpu_count)
+# timeout the cached result was obtained with: a FAILED probe is only
+# authoritative for timeouts <= this; a later caller with a longer
+# timeout (bench) re-probes instead of inheriting the stale miss
+_cached_timeout: float = 0.0
 
 
 def probe_accelerator(
@@ -35,9 +39,13 @@ def probe_accelerator(
     (bench.py) pass force=True and a generous timeout that covers first
     TPU init (~20-40s).
     """
-    global _cached
+    global _cached, _cached_timeout
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("RAY_TPU_DETECT_TIMEOUT", "20"))
     if _cached is not None:
-        return _cached
+        if _cached != ("", 0) or timeout_s <= _cached_timeout:
+            return _cached
+        # cached miss, but this caller allows a longer probe: retry
     if not force and "jax" not in sys.modules:
         return ("", 0)  # not cached: a later forced probe may differ
     if "jax" in sys.modules:
@@ -57,9 +65,8 @@ def probe_accelerator(
                 )
             except Exception:
                 _cached = ("", 0)
+            _cached_timeout = float("inf")  # in-process answer is exact
             return _cached
-    if timeout_s is None:
-        timeout_s = float(os.environ.get("RAY_TPU_DETECT_TIMEOUT", "20"))
     try:
         out = subprocess.run(
             [
@@ -76,6 +83,7 @@ def probe_accelerator(
         _cached = (platform, int(count))
     except Exception:
         _cached = ("", 0)
+    _cached_timeout = timeout_s
     return _cached
 
 
@@ -85,7 +93,23 @@ def safe_tpu_device_count() -> int:
     return probe_accelerator()[1]
 
 
+def tpu_env_markers() -> bool:
+    """True when the environment advertises a TPU (GCE metadata env,
+    axon tunnel, explicit accelerator type) — probing is then worth a
+    subprocess jax import even if this process never imported jax."""
+    return any(
+        os.environ.get(k)
+        for k in (
+            "TPU_ACCELERATOR_TYPE",
+            "TPU_NAME",
+            "PALLAS_AXON_POOL_IPS",
+            "PALLAS_AXON_TPU_GEN",
+        )
+    )
+
+
 def reset_probe_cache() -> None:
     """Drop the cached probe result (tests; tunnel recovery)."""
-    global _cached
+    global _cached, _cached_timeout
     _cached = None
+    _cached_timeout = 0.0

@@ -51,9 +51,13 @@ def _detect_num_tpus() -> int:
     env = os.environ.get("RAY_TPU_NUM_TPUS")
     if env is not None:
         return int(env)
-    from .jax_utils import safe_tpu_device_count
+    from .jax_utils import probe_accelerator, tpu_env_markers
 
-    return safe_tpu_device_count()
+    # When the env advertises a TPU, probe even if jax was never
+    # imported here (worth the subprocess); otherwise only an already-
+    # imported jax is consulted — a CPU-only init() stays instant.
+    # RAY_TPU_NUM_TPUS is the explicit override for marker-less hosts.
+    return probe_accelerator(force=tpu_env_markers())[1]
 
 
 def init(
@@ -140,6 +144,7 @@ def init(
             # (or simulated hosts in tests) can register
             tcp=bool(kwargs.get("_tcp_hub") or os.environ.get("RAY_TPU_TCP_HUB")),
             host=kwargs.get("_hub_host", "127.0.0.1"),
+            port=int(kwargs.get("_hub_port", 0)),
             object_store_memory=object_store_memory,
         )
         _hub.start()

@@ -457,6 +457,50 @@ class StreamingExecutor:
         else:
             raise NotImplementedError(op.name)
 
+    def _exchange_parts(
+        self, refs: List[Any], submit_split: Callable[[Any], List[Any]], k: int
+    ) -> List[List[Any]]:
+        """Map phase of a 2-stage exchange -> per-partition piece lists.
+
+        With ``DataContext.use_push_based_shuffle`` (default), map
+        outputs are consumed in rounds of ~sqrt(M): each round's k
+        pieces are partially concatenated as soon as that round's maps
+        are submitted, so partial merges overlap the remaining maps and
+        the final per-partition merge fans in O(sqrt(M)) refs instead of
+        M (reference: push_based_shuffle_task_scheduler.py:112,400 —
+        pipelined map/merge rounds). Pull-based fallback keeps one piece
+        per map."""
+        parts: List[List[Any]] = [[] for _ in range(k)]
+        if k == 1:
+            parts[0] = list(refs)
+            return parts
+        push = self.ctx.use_push_based_shuffle and len(refs) > 3
+        if not push:
+            for ref in refs:
+                for i, piece in enumerate(submit_split(ref)):
+                    parts[i].append(piece)
+            return parts
+        ray = self._ray()
+        concat = ray.remote(lambda *bs: BlockAccessor.concat(list(bs)))
+        round_size = max(2, int(len(refs) ** 0.5))
+        pending: List[List[Any]] = []
+
+        def flush_round():
+            for i in range(k):
+                pieces = [out[i] for out in pending]
+                parts[i].append(
+                    concat.remote(*pieces) if len(pieces) > 1 else pieces[0]
+                )
+
+        for ref in refs:
+            pending.append(submit_split(ref))
+            if len(pending) >= round_size:
+                flush_round()
+                pending.clear()
+        if pending:
+            flush_round()
+        return parts
+
     def _repartition(self, refs: List[Any], k: int) -> Iterator[Any]:
         ray = self._ray()
 
@@ -467,14 +511,9 @@ class StreamingExecutor:
             return [acc.slice(cuts[i], cuts[i + 1]) for i in range(k)]
 
         split_remote = ray.remote(split).options(num_returns=k) if k > 1 else None
-        parts: List[List[Any]] = [[] for _ in range(k)]
-        for ref in refs:
-            if k == 1:
-                parts[0].append(ref)
-            else:
-                out = split_remote.remote(ref, k)
-                for i, r in enumerate(out):
-                    parts[i].append(r)
+        parts = self._exchange_parts(
+            refs, lambda ref: split_remote.remote(ref, k), k
+        )
         merge = ray.remote(lambda *blocks: BlockAccessor.concat(list(blocks)))
         for i in range(k):
             yield merge.remote(*parts[i]) if parts[i] else ray.put([])
@@ -491,16 +530,10 @@ class StreamingExecutor:
             assign = r.randint(0, k, size=n)
             return [acc.take(np.nonzero(assign == i)[0]) for i in range(k)]
 
-        parts: List[List[Any]] = [[] for _ in range(k)]
         split_remote = ray.remote(split_shuffled).options(num_returns=k)
-        for ref in refs:
-            s = rng.randrange(2**31)
-            if k == 1:
-                parts[0].append(ref)
-                continue
-            out = split_remote.remote(ref, k, s)
-            for i, r in enumerate(out):
-                parts[i].append(r)
+        parts = self._exchange_parts(
+            refs, lambda ref: split_remote.remote(ref, k, rng.randrange(2**31)), k
+        )
 
         def merge_shuffle(s: int, *blocks: Block) -> Block:
             merged = BlockAccessor.concat(list(blocks))
@@ -550,15 +583,10 @@ class StreamingExecutor:
             assign = np.searchsorted(np.asarray(cuts_), vals, side="right")
             return [acc.take(np.nonzero(assign == i)[0]) for i in range(len(cuts_) + 1)]
 
-        parts: List[List[Any]] = [[] for _ in range(k)]
         split_remote = ray.remote(split_range).options(num_returns=k)
-        for ref in refs:
-            if k == 1:
-                parts[0].append(ref)
-                continue
-            out = split_remote.remote(ref, cuts)
-            for i, r in enumerate(out):
-                parts[i].append(r)
+        parts = self._exchange_parts(
+            refs, lambda ref: split_remote.remote(ref, cuts), k
+        )
 
         def merge_sorted(*blocks: Block) -> Block:
             merged = BlockAccessor.concat(list(blocks))
@@ -595,15 +623,10 @@ class StreamingExecutor:
             )
             return [acc.take(np.nonzero(hashes == i)[0]) for i in range(k)]
 
-        parts: List[List[Any]] = [[] for _ in range(k)]
         split_remote = ray.remote(split_hash).options(num_returns=k)
-        for ref in refs:
-            if k == 1:
-                parts[0].append(ref)
-                continue
-            out = split_remote.remote(ref, k)
-            for i, r in enumerate(out):
-                parts[i].append(r)
+        parts = self._exchange_parts(
+            refs, lambda ref: split_remote.remote(ref, k), k
+        )
 
         def combine(key_, aggs_, *blocks: Block) -> Block:
             from ..aggregate import aggregate_block

@@ -192,18 +192,9 @@ class _EpsilonGreedyRunner:
             next_obs, rewards, term, trunc, infos = self.envs.step(actions)
             # time-limit truncation is not termination for bootstrapping
             done_for_target = np.asarray(term, np.float32)
-            # SAME_STEP autoreset: at done steps next_obs is the NEW
-            # episode's reset obs; store the true final obs so replayed
-            # truncation steps bootstrap the right state
-            next_store = next_obs
-            final_obs = infos.get("final_obs")
-            if final_obs is not None:
-                done_idx = np.nonzero(np.logical_or(term, trunc))[0]
-                if len(done_idx):
-                    next_store = next_obs.copy()
-                    for i in done_idx:
-                        if final_obs[i] is not None:
-                            next_store[i] = np.asarray(final_obs[i])
+            from .env_runner import substitute_final_obs
+
+            next_store = substitute_final_obs(next_obs, term, trunc, infos)
             sl = slice(t * N, (t + 1) * N)
             out["obs"][sl] = obs.reshape(N, -1)
             out["actions"][sl] = actions
@@ -287,7 +278,9 @@ class DQN:
                 if self._updates % c.target_network_update_freq == 0:
                     self.target_params = jax.tree.map(lambda x: x, self.params)
             host_params = jax.tree.map(np.asarray, self.params)
-        episode_returns = [r for w in latest_windows.values() for r in w]
+        from .env_runner import merge_return_windows
+
+        episode_returns = merge_return_windows(latest_windows)
         self.iteration += 1
         return {
             "training_iteration": self.iteration,

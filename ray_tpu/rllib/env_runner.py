@@ -14,6 +14,31 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import numpy as np
 
 
+def substitute_final_obs(next_obs, term, trunc, infos) -> np.ndarray:
+    """SAME_STEP autoreset returns the NEW episode's reset obs at done
+    steps; replay-style transitions must store the true final obs
+    (infos["final_obs"]) or the critic bootstraps into an unrelated
+    state. Shared by the DQN and SAC runners."""
+    final_obs = infos.get("final_obs")
+    if final_obs is None:
+        return next_obs
+    done_idx = np.nonzero(np.logical_or(term, trunc))[0]
+    if not len(done_idx):
+        return next_obs
+    out = next_obs.copy()
+    for i in done_idx:
+        if final_obs[i] is not None:
+            out[i] = np.asarray(final_obs[i])
+    return out
+
+
+def merge_return_windows(latest_windows: Dict[int, list]) -> list:
+    """Per-runner last-100 windows are cumulative: keep only the newest
+    per runner (the dict values) and concat across runners — extending
+    every round would double-count episodes."""
+    return [r for window in latest_windows.values() for r in window]
+
+
 class SingleAgentEnvRunner:
     def __init__(
         self,

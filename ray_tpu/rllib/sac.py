@@ -153,7 +153,7 @@ def make_sac_update(config: SACConfig, act_dim: int):
     import optax
 
     key = (config.actor_lr, config.critic_lr, config.alpha_lr, config.gamma,
-           config.tau, act_dim, tuple(config.hiddens))
+           config.tau, config.target_entropy, act_dim, tuple(config.hiddens))
     cached = _UPDATE_CACHE.get(key)
     if cached is not None:
         return cached
@@ -297,19 +297,9 @@ class _GaussianRunner:
                 )
             self._step += 1
             next_obs, rewards, term, trunc, infos = self.envs.step(self._to_env(act))
-            # SAME_STEP autoreset returns the NEW episode's reset obs at
-            # done steps; the transition must store the true final obs
-            # (infos["final_obs"]) or the critic bootstraps into an
-            # unrelated state on every truncation
-            next_store = next_obs
-            final_obs = infos.get("final_obs")
-            if final_obs is not None:
-                done_idx = np.nonzero(np.logical_or(term, trunc))[0]
-                if len(done_idx):
-                    next_store = next_obs.copy()
-                    for i in done_idx:
-                        if final_obs[i] is not None:
-                            next_store[i] = np.asarray(final_obs[i])
+            from .env_runner import substitute_final_obs
+
+            next_store = substitute_final_obs(next_obs, term, trunc, infos)
             sl = slice(t * N, (t + 1) * N)
             out["obs"][sl] = obs.reshape(N, -1)
             out["actions"][sl] = act
@@ -400,7 +390,9 @@ class SAC:
                 closs, aloss = float(cl), float(al)
             (self.params, self.target_q, self.log_alpha, self.opt_states) = state
             host_pi = jax.tree.map(np.asarray, self.params["pi"])
-        episode_returns = [r for w in latest_windows.values() for r in w]
+        from .env_runner import merge_return_windows
+
+        episode_returns = merge_return_windows(latest_windows)
         self.iteration += 1
         return {
             "training_iteration": self.iteration,

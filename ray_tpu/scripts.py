@@ -87,6 +87,7 @@ def cmd_start(args) -> None:
             max_workers=args.max_workers,
             _tcp_hub=True,
             _hub_host=args.host,
+            _hub_port=args.port,
         )
         addr = ctx.address_info["address"]
         with open(_ADDR_FILE, "w") as f:
@@ -95,7 +96,7 @@ def cmd_start(args) -> None:
             f.write(str(os.getpid()))
         print(f"ray_tpu head started at {addr}")
         print("connect with: ray_tpu.init(address=" + repr(addr) + ")")
-        print(f"stop with: python -m ray_tpu stop")
+        print("stop with: python -m ray_tpu stop")
         # Head blocks for its lifetime (reference: ray start --block; a
         # non-blocking daemonizing head adds nothing on one host where
         # drivers embed the hub in-process anyway).
@@ -104,6 +105,15 @@ def cmd_start(args) -> None:
                 time.sleep(3600)
         except KeyboardInterrupt:
             pass
+        finally:
+            # Ctrl-C is the normal way to stop a blocking head: leaving
+            # the address/pid files behind would point later CLI calls
+            # at a dead endpoint (or a recycled pid)
+            for path in (_PID_FILE, _ADDR_FILE):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         return
     # join an existing cluster as a node agent (reference: ray start
     # --address=...)
@@ -287,6 +297,8 @@ def _build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("start", help="start a head or join as a node")
     sp.add_argument("--head", action="store_true")
     sp.add_argument("--host", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=0,
+                    help="head listen port (0 = ephemeral)")
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.add_argument("--max-workers", type=int, default=None)

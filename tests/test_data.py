@@ -223,3 +223,57 @@ class TestIO:
         p.write_bytes(b"\x00\x01")
         rows = rd.read_binary_files(str(p)).take_all()
         assert rows[0]["bytes"] == b"\x00\x01"
+
+
+class TestPushBasedShuffle:
+    """Pipelined map/merge-round exchange (reference:
+    push_based_shuffle_task_scheduler.py; DataContext.use_push_based_shuffle)."""
+
+    def _rows(self, ds):
+        return sorted(int(r["id"]) for r in ds.iter_rows())
+
+    def test_push_and_pull_paths_agree(self, ray_start_regular):
+        import ray_tpu.data as rd
+        from ray_tpu.data.context import DataContext
+
+        ctx = DataContext.get_current()
+        orig = ctx.use_push_based_shuffle
+        try:
+            n = 200
+            expected = list(range(n))
+            for flag in (True, False):
+                ctx.use_push_based_shuffle = flag
+                ds = rd.range(n, override_num_blocks=9).random_shuffle(seed=4)
+                assert self._rows(ds) == expected, f"push={flag}"
+                ds = rd.range(n, override_num_blocks=9).repartition(3)
+                assert self._rows(ds) == expected, f"push={flag}"
+                ds = rd.range(n, override_num_blocks=9).sort("id")
+                got = [int(r["id"]) for r in ds.iter_rows()]
+                assert got == expected, f"push={flag}"
+        finally:
+            ctx.use_push_based_shuffle = orig
+
+    def test_partial_merge_rounds_bound_fan_in(self, ray_start_regular):
+        """With M maps, each partition's final merge consumes
+        O(sqrt(M)) partial refs, not M."""
+        from ray_tpu.data._internal.executor import StreamingExecutor
+        import ray_tpu
+
+        ex = StreamingExecutor([])
+        refs = [ray_tpu.put({"id": __import__("numpy").arange(4) + 4 * i}) for i in range(16)]
+        k = 4
+        calls = []
+
+        def submit(ref):
+            calls.append(ref)
+            split = ray_tpu.remote(
+                lambda b, kk=k: [
+                    {key: v[i::kk] for key, v in b.items()} for i in range(kk)
+                ]
+            ).options(num_returns=k)
+            return split.remote(ref)
+
+        parts = ex._exchange_parts(refs, submit, k)
+        assert len(calls) == 16
+        # 16 maps -> rounds of 4 -> 4 partials per partition
+        assert all(len(p) == 4 for p in parts)

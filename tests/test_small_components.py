@@ -134,3 +134,30 @@ def test_serve_multiplex_routing_prefers_holder(ray_start_4_cpus):
         assert pids == {first}, f"expected affinity to {first}, got {pids}"
     finally:
         serve.shutdown()
+
+
+def test_joblib_backend_sklearn(ray_start_regular):
+    """Ecosystem shim: joblib/sklearn n_jobs parallelism as tasks
+    (reference: python/ray/util/joblib/)."""
+    import joblib
+    import numpy as np
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+
+    def cube(x):
+        return x ** 3
+
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=2)(joblib.delayed(cube)(i) for i in range(6))
+    assert out == [0, 1, 8, 27, 64, 125]
+
+    from sklearn.datasets import make_classification
+    from sklearn.ensemble import RandomForestClassifier
+
+    X, y = make_classification(n_samples=120, n_features=6, random_state=0)
+    with joblib.parallel_backend("ray_tpu"):
+        clf = RandomForestClassifier(n_estimators=6, n_jobs=2, random_state=0)
+        clf.fit(X, y)
+    assert clf.score(X, y) > 0.9
