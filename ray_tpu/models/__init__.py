@@ -5,7 +5,7 @@ framework ships JAX-native models so its ML libraries have first-class
 workloads (flagship: Llama — BASELINE.json north star).
 """
 
-from . import llama
+from . import llama, moe_llama
 from .llama import (
     LLAMA_2_7B,
     LLAMA_3_8B,
@@ -14,13 +14,18 @@ from .llama import (
     LLAMA_TINY,
     LlamaConfig,
 )
+from .moe_llama import MIXTRAL_8X7B, MOE_TINY, MoELlamaConfig
 
 __all__ = [
     "llama",
+    "moe_llama",
     "LlamaConfig",
     "LLAMA_2_7B",
     "LLAMA_3_8B",
     "LLAMA_3_70B",
     "LLAMA_BENCH",
     "LLAMA_TINY",
+    "MoELlamaConfig",
+    "MIXTRAL_8X7B",
+    "MOE_TINY",
 ]

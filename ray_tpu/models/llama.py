@@ -247,9 +247,11 @@ def _attention(q, k, v, config: LlamaConfig):
     return _attention_xla(q, k, v, config)
 
 
-def block_fn(config: LlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
-             cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """One transformer block. x: (B, S, D) in config.dtype."""
+def attention_sublayer(config: LlamaConfig, x: jax.Array,
+                       layer: Dict[str, jax.Array],
+                       cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Pre-norm GQA attention + residual (shared by the dense and MoE
+    model families — fix attention once, both models follow)."""
     c = config
     h = rms_norm(x, layer["attn_norm"], c.norm_eps)
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(c.dtype))
@@ -258,7 +260,14 @@ def block_fn(config: LlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn = _attention(q, k, v, c)
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(c.dtype))
+    return x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(c.dtype))
+
+
+def block_fn(config: LlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
+             cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """One transformer block. x: (B, S, D) in config.dtype."""
+    c = config
+    x = attention_sublayer(c, x, layer, cos, sin)
 
     h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
     gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(c.dtype))
@@ -299,24 +308,34 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
     return logits
 
 
-def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
-            config: LlamaConfig) -> jax.Array:
-    """Next-token cross entropy. batch: {"tokens": (B, S+1) int32} or
-    {"inputs": (B,S), "targets": (B,S)} with optional "mask"."""
+def unpack_batch(batch: Dict[str, jax.Array]):
+    """batch {"tokens": (B, S+1)} or {"inputs","targets"} [+"mask"]
+    -> (inputs, targets, mask) — shared by both model families."""
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
-    else:
-        inputs, targets, mask = batch["inputs"], batch["targets"], batch.get("mask")
-    logits = forward(params, inputs, config)
+        return inputs, targets, mask
+    return batch["inputs"], batch["targets"], batch.get("mask")
+
+
+def masked_ce(logits: jax.Array, targets: jax.Array, mask) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            config: LlamaConfig) -> jax.Array:
+    """Next-token cross entropy. batch: {"tokens": (B, S+1) int32} or
+    {"inputs": (B,S), "targets": (B,S)} with optional "mask"."""
+    inputs, targets, mask = unpack_batch(batch)
+    logits = forward(params, inputs, config)
+    return masked_ce(logits, targets, mask)
 
 
 # ---------------------------------------------------------------------

@@ -1,0 +1,212 @@
+"""Mixtral-style MoE Llama: the flagship architecture with every dense
+FFN replaced by a top-k routed expert FFN.
+
+Second first-class model family (the reference ships none in-tree —
+it serves models through vLLM; here models are in-tree and mesh-aware).
+Reuses the Llama attention stack (GQA/RoPE/RMSNorm, stacked-layer scan,
+flash/ring attention impls) from models/llama.py and the capacity-
+bounded expert dispatch from ops/moe.py; experts shard over the
+`expert` mesh axis (param_specs), tokens reach them via GSPMD
+all-to-all — the §2.5 EP strategy as a real model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops import MoEConfig, moe_ffn
+
+from .llama import (
+    LlamaConfig,
+    attention_sublayer,
+    masked_ce,
+    rms_norm,
+    rope_table,
+    unpack_batch,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoELlamaConfig(LlamaConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coeff: float = 0.01
+
+    @property
+    def moe(self) -> MoEConfig:
+        return MoEConfig(
+            d_model=self.dim,
+            d_ff=self.ffn_dim,
+            n_experts=self.n_experts,
+            k=self.experts_per_token,
+            capacity_factor=self.capacity_factor,
+        )
+
+
+# Stock shapes (public Mixtral architecture table) + test-size config.
+MIXTRAL_8X7B = MoELlamaConfig(
+    vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    ffn_dim=14336, max_seq_len=32768, rope_theta=1e6,
+    n_experts=8, experts_per_token=2,
+)
+MOE_TINY = MoELlamaConfig(
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, max_seq_len=128, rope_theta=10000.0, remat=False,
+    n_experts=4, experts_per_token=2,
+)
+
+
+def param_specs(config: MoELlamaConfig) -> Dict[str, Any]:
+    """Llama attention shardings + experts on the `expert` axis.
+
+    Expert matrices are (L, E, D, F): E shards over `expert` (EP), and
+    the per-expert matrices additionally shard fsdp/model exactly like
+    the dense FFN — EP composes with TP/FSDP."""
+    return {
+        "embed": P("model", "fsdp"),
+        "blocks": {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "model", None),
+            "wk": P(None, "fsdp", "model", None),
+            "wv": P(None, "fsdp", "model", None),
+            "wo": P(None, "model", None, "fsdp"),
+            "mlp_norm": P(None, None),
+            "router": P(None, "fsdp", None),            # (L, D, E)
+            "w_gate": P(None, "expert", "fsdp", "model"),  # (L, E, D, F)
+            "w_up": P(None, "expert", "fsdp", "model"),
+            "w_down": P(None, "expert", "model", "fsdp"),  # (L, E, F, D)
+        },
+        "final_norm": P(None),
+        "lm_head": P("fsdp", "model"),
+    }
+
+
+def init_params(rng: jax.Array, config: MoELlamaConfig) -> Dict[str, Any]:
+    c = config
+    hd = c.head_dim
+    keys = jax.random.split(rng, 10)
+    (k_embed, k_q, k_k, k_v, k_o, k_r, k_g, k_u, k_d, k_lm) = keys
+
+    def dense(key, shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(
+            c.param_dtype
+        )
+
+    L, E = c.n_layers, c.n_experts
+    return {
+        "embed": dense(k_embed, (c.vocab_size, c.dim), c.dim),
+        "blocks": {
+            "attn_norm": jnp.ones((L, c.dim), c.param_dtype),
+            "wq": dense(k_q, (L, c.dim, c.n_heads, hd), c.dim),
+            "wk": dense(k_k, (L, c.dim, c.n_kv_heads, hd), c.dim),
+            "wv": dense(k_v, (L, c.dim, c.n_kv_heads, hd), c.dim),
+            "wo": dense(k_o, (L, c.n_heads, hd, c.dim), c.n_heads * hd),
+            "mlp_norm": jnp.ones((L, c.dim), c.param_dtype),
+            # router stays float32: tiny, and routing is precision-
+            # sensitive (standard MoE practice)
+            "router": (
+                jax.random.normal(k_r, (L, c.dim, E)) / math.sqrt(c.dim)
+            ).astype(jnp.float32),
+            "w_gate": dense(k_g, (L, E, c.dim, c.ffn_dim), c.dim),
+            "w_up": dense(k_u, (L, E, c.dim, c.ffn_dim), c.dim),
+            "w_down": dense(k_d, (L, E, c.ffn_dim, c.dim), c.ffn_dim),
+        },
+        "final_norm": jnp.ones((c.dim,), c.param_dtype),
+        "lm_head": dense(k_lm, (c.dim, c.vocab_size), c.dim),
+    }
+
+
+def param_count(config: MoELlamaConfig) -> int:
+    c = config
+    attn = (
+        2 * c.dim
+        + c.dim * c.n_heads * c.head_dim
+        + 2 * c.dim * c.n_kv_heads * c.head_dim
+        + c.n_heads * c.head_dim * c.dim
+    )
+    moe = c.dim * c.n_experts + 3 * c.n_experts * c.dim * c.ffn_dim
+    return c.vocab_size * c.dim * 2 + c.n_layers * (attn + moe) + c.dim
+
+
+def active_param_count(config: MoELlamaConfig) -> int:
+    """Params touched per token (k of E experts) — the FLOPs-relevant
+    count for MFU math on MoE models."""
+    c = config
+    attn = (
+        2 * c.dim
+        + c.dim * c.n_heads * c.head_dim
+        + 2 * c.dim * c.n_kv_heads * c.head_dim
+        + c.n_heads * c.head_dim * c.dim
+    )
+    moe = c.dim * c.n_experts + 3 * c.experts_per_token * c.dim * c.ffn_dim
+    return c.vocab_size * c.dim * 2 + c.n_layers * (attn + moe) + c.dim
+
+
+def block_fn(config: MoELlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
+             cos: jax.Array, sin: jax.Array, mask=None):
+    """One MoE transformer block. Returns (x, aux_loss)."""
+    c = config
+    x = attention_sublayer(c, x, layer, cos, sin)
+
+    h = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+    moe_params = {
+        # router stays fp32 (precision-sensitive); expert matmuls — the
+        # bulk of the FLOPs — run in config.dtype like the dense FFN
+        "router": layer["router"],
+        "w_gate": layer["w_gate"].astype(c.dtype),
+        "w_up": layer["w_up"].astype(c.dtype),
+        "w_down": layer["w_down"].astype(c.dtype),
+    }
+    out, aux = moe_ffn(moe_params, h.astype(c.dtype), c.moe, mask=mask)
+    return x + out.astype(x.dtype), aux
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            config: MoELlamaConfig, mask=None):
+    """tokens (B, S) int32 -> (logits (B, S, V) float32, aux_loss).
+
+    Same stacked-layer lax.scan shape as the dense model; the router
+    aux losses accumulate through the scan carry. ``mask`` (B, S)
+    excludes padding tokens from expert capacity and balance stats."""
+    c = config
+    B, S = tokens.shape
+    x = params["embed"].astype(c.dtype)[tokens]
+    cos, sin = rope_table(c, S)
+
+    blk = partial(block_fn, c)
+    if c.remat:
+        blk = jax.checkpoint(
+            blk, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    def scan_body(carry, layer):
+        x, aux_sum = carry
+        x, aux = blk(x, layer, cos, sin, mask)
+        return (x, aux_sum + aux), None
+
+    (x, aux_sum), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+    )
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(c.dtype))
+    logits = logits.astype(jnp.float32)
+    if c.logit_softcap:
+        logits = jnp.tanh(logits / c.logit_softcap) * c.logit_softcap
+    return logits, aux_sum / c.n_layers
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            config: MoELlamaConfig) -> jax.Array:
+    """Next-token cross entropy + router load-balancing aux loss."""
+    inputs, targets, mask = unpack_batch(batch)
+    logits, aux = forward(params, inputs, config, mask=mask)
+    return masked_ce(logits, targets, mask) + config.router_aux_coeff * aux

@@ -37,6 +37,7 @@ def top_k_gating(
     k: int = 2,
     capacity_factor: float = 1.25,
     min_capacity: int = 4,
+    token_mask: jax.Array = None,  # (T,) 1=real token, 0=padding
 ) -> GatingResult:
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -49,6 +50,13 @@ def top_k_gating(
     # position of each (token, choice) within its expert's capacity:
     # cumulative count of prior assignments to the same expert
     onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # (T, k, E)
+    if token_mask is not None:
+        # padding tokens get no expert: they consume no capacity, emit
+        # zero output, and are excluded from the balance statistics —
+        # otherwise the router learns to balance pad tokens
+        m32 = token_mask.astype(jnp.float32).reshape(T)
+        gate_vals = gate_vals * m32[:, None]
+        onehot = onehot * token_mask.astype(jnp.int32).reshape(T, 1, 1)
     flat = onehot.reshape(T * k, E)
     # priority order: all k=0 choices first, then k=1 (standard
     # switch/gshard ordering keeps top-1 assignments dense)
@@ -60,17 +68,27 @@ def top_k_gating(
     slot = (pos * onehot).sum(-1)  # (T, k) slot within expert
     keep = slot < capacity
 
+    keep_f = keep[:, :, None, None].astype(jnp.float32)
+    if token_mask is not None:
+        keep_f = keep_f * token_mask.astype(jnp.float32).reshape(T, 1, 1, 1)
     disp = (
         jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)[..., None]
         * jax.nn.one_hot(slot, capacity, dtype=jnp.float32)[:, :, None, :]
-        * keep[:, :, None, None]
+        * keep_f
     )  # (T, k, E, C)
     dispatch = disp.sum(1)  # (T, E, C)
     combine = (disp * gate_vals[:, :, None, None]).sum(1)
 
-    # load-balance aux loss (Switch Transformer): E * sum(f_e * p_e)
-    me = probs.mean(0)  # mean router prob per expert
-    ce = onehot.sum(1).astype(jnp.float32).mean(0)  # fraction routed (pre-drop)
+    # load-balance aux loss (Switch Transformer): E * sum(f_e * p_e),
+    # statistics over REAL tokens only when a mask is given
+    if token_mask is not None:
+        m32 = token_mask.astype(jnp.float32).reshape(T)
+        denom = jnp.maximum(m32.sum(), 1.0)
+        me = (probs * m32[:, None]).sum(0) / denom
+        ce = onehot.sum(1).astype(jnp.float32).sum(0) / denom
+    else:
+        me = probs.mean(0)  # mean router prob per expert
+        ce = onehot.sum(1).astype(jnp.float32).mean(0)  # fraction routed (pre-drop)
     aux = (me * ce).sum() * E
     return GatingResult(dispatch, combine, aux, ce)
 
@@ -101,6 +119,7 @@ def moe_ffn(
     params: dict,
     x: jax.Array,  # (B, S, D)
     config: MoEConfig,
+    mask: jax.Array = None,  # (B, S) 1=real token, 0=padding
 ) -> Tuple[jax.Array, jax.Array]:
     """Routed SwiGLU expert FFN. Returns (out (B,S,D), aux_loss).
 
@@ -113,7 +132,10 @@ def moe_ffn(
     xt = x.reshape(T, D)
     logits = xt.astype(jnp.float32) @ params["router"]
     gate = top_k_gating(
-        logits, k=config.k, capacity_factor=config.capacity_factor
+        logits,
+        k=config.k,
+        capacity_factor=config.capacity_factor,
+        token_mask=None if mask is None else mask.reshape(T),
     )
     # dispatch: (T,D),(T,E,C) -> (E,C,D)
     xe = jnp.einsum("td,tec->ecd", xt, gate.dispatch.astype(x.dtype))
