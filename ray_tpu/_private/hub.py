@@ -304,6 +304,9 @@ class Hub:
         self.metrics: Dict[Tuple[str, tuple], dict] = {}
         self.task_events: deque = deque(maxlen=int(self.config.task_events_max))
         self._task_event_index: Dict[bytes, dict] = {}
+        # user/library tracing spans (reference: ray.util.tracing's
+        # opentelemetry spans; here they land in the same timeline)
+        self.spans: deque = deque(maxlen=int(self.config.task_events_max))
         self.client_conns: List[Any] = []
         self.driver_conn = None
         self._running = True
@@ -916,6 +919,10 @@ class Hub:
         s.credit_waiters = still
 
     # ----- metrics registry (reference: src/ray/stats/metric.h:104)
+    def _on_span_record(self, conn, p):
+        """Finished tracing span from any process (util/tracing.py)."""
+        self.spans.append(p)
+
     def _on_metric_record(self, conn, p):
         key = (p["name"], p["tags"])
         m = self.metrics.get(key)
@@ -2064,6 +2071,22 @@ class Hub:
                     "tid": ev.get("worker_id", ""),
                     "args": {"task_id": ev["task_id"],
                              "state": ev.get("state")},
+                })
+            for sp in self.spans:
+                items.append({
+                    "name": sp.get("name", ""),
+                    "cat": "span",
+                    "ph": "X",
+                    "ts": sp["start"] * 1e6,
+                    "dur": max(0.0, (sp["end"] - sp["start"]) * 1e6),
+                    "pid": sp.get("node_id", "node0"),
+                    "tid": f"pid={sp.get('pid', '')}",
+                    "args": {
+                        "trace_id": sp.get("trace_id"),
+                        "span_id": sp.get("span_id"),
+                        "parent_id": sp.get("parent_id"),
+                        **(sp.get("attrs") or {}),
+                    },
                 })
         elif kind == "placement_groups":
             for g in self.pgs.values():

@@ -112,28 +112,57 @@ def param_specs(config: LlamaConfig) -> Dict[str, Any]:
     }
 
 
-def init_params(rng: jax.Array, config: LlamaConfig) -> Dict[str, Any]:
-    """Initialize parameters (stacked-layer layout, param_dtype)."""
-    c = config
-    hd = c.head_dim
-    k_embed, k_q, k_k, k_v, k_o, k_g, k_u, k_d, k_lm = jax.random.split(rng, 9)
+def make_dense_init(config: LlamaConfig):
+    """Scaled-normal initializer in config.param_dtype (shared by the
+    dense and MoE model families)."""
 
     def dense(key, shape, fan_in):
         scale = 1.0 / math.sqrt(fan_in)
         return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(
-            c.param_dtype
+            config.param_dtype
         )
 
+    return dense
+
+
+def init_attn_params(config: LlamaConfig, keys, dense) -> Dict[str, Any]:
+    """Stacked attention sublayer params (norms + qkvo) — the shared
+    half of both families' block params. keys: (k_q, k_k, k_v, k_o)."""
+    c = config
+    hd = c.head_dim
+    L = c.n_layers
+    k_q, k_k, k_v, k_o = keys
+    return {
+        "attn_norm": jnp.ones((L, c.dim), c.param_dtype),
+        "wq": dense(k_q, (L, c.dim, c.n_heads, hd), c.dim),
+        "wk": dense(k_k, (L, c.dim, c.n_kv_heads, hd), c.dim),
+        "wv": dense(k_v, (L, c.dim, c.n_kv_heads, hd), c.dim),
+        "wo": dense(k_o, (L, c.n_heads, hd, c.dim), c.n_heads * hd),
+        "mlp_norm": jnp.ones((L, c.dim), c.param_dtype),
+    }
+
+
+def attn_param_count(config: LlamaConfig) -> int:
+    """Per-layer params of the shared attention sublayer + both norms."""
+    c = config
+    return (
+        2 * c.dim
+        + c.dim * c.n_heads * c.head_dim
+        + 2 * c.dim * c.n_kv_heads * c.head_dim
+        + c.n_heads * c.head_dim * c.dim
+    )
+
+
+def init_params(rng: jax.Array, config: LlamaConfig) -> Dict[str, Any]:
+    """Initialize parameters (stacked-layer layout, param_dtype)."""
+    c = config
+    k_embed, k_q, k_k, k_v, k_o, k_g, k_u, k_d, k_lm = jax.random.split(rng, 9)
+    dense = make_dense_init(c)
     L = c.n_layers
     return {
         "embed": dense(k_embed, (c.vocab_size, c.dim), c.dim),
         "blocks": {
-            "attn_norm": jnp.ones((L, c.dim), c.param_dtype),
-            "wq": dense(k_q, (L, c.dim, c.n_heads, hd), c.dim),
-            "wk": dense(k_k, (L, c.dim, c.n_kv_heads, hd), c.dim),
-            "wv": dense(k_v, (L, c.dim, c.n_kv_heads, hd), c.dim),
-            "wo": dense(k_o, (L, c.n_heads, hd, c.dim), c.n_heads * hd),
-            "mlp_norm": jnp.ones((L, c.dim), c.param_dtype),
+            **init_attn_params(c, (k_q, k_k, k_v, k_o), dense),
             "w_gate": dense(k_g, (L, c.dim, c.ffn_dim), c.dim),
             "w_up": dense(k_u, (L, c.dim, c.ffn_dim), c.dim),
             "w_down": dense(k_d, (L, c.ffn_dim, c.dim), c.ffn_dim),
@@ -145,13 +174,7 @@ def init_params(rng: jax.Array, config: LlamaConfig) -> Dict[str, Any]:
 
 def param_count(config: LlamaConfig) -> int:
     c = config
-    per_layer = (
-        2 * c.dim
-        + c.dim * c.n_heads * c.head_dim
-        + 2 * c.dim * c.n_kv_heads * c.head_dim
-        + c.n_heads * c.head_dim * c.dim
-        + 3 * c.dim * c.ffn_dim
-    )
+    per_layer = attn_param_count(c) + 3 * c.dim * c.ffn_dim
     return c.vocab_size * c.dim * 2 + c.n_layers * per_layer + c.dim
 
 

@@ -26,6 +26,9 @@ from ray_tpu.ops import MoEConfig, moe_ffn
 from .llama import (
     LlamaConfig,
     attention_sublayer,
+    attn_param_count,
+    init_attn_params,
+    make_dense_init,
     masked_ce,
     rms_norm,
     rope_table,
@@ -91,26 +94,14 @@ def param_specs(config: MoELlamaConfig) -> Dict[str, Any]:
 
 def init_params(rng: jax.Array, config: MoELlamaConfig) -> Dict[str, Any]:
     c = config
-    hd = c.head_dim
     keys = jax.random.split(rng, 10)
     (k_embed, k_q, k_k, k_v, k_o, k_r, k_g, k_u, k_d, k_lm) = keys
-
-    def dense(key, shape, fan_in):
-        scale = 1.0 / math.sqrt(fan_in)
-        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale).astype(
-            c.param_dtype
-        )
-
+    dense = make_dense_init(c)
     L, E = c.n_layers, c.n_experts
     return {
         "embed": dense(k_embed, (c.vocab_size, c.dim), c.dim),
         "blocks": {
-            "attn_norm": jnp.ones((L, c.dim), c.param_dtype),
-            "wq": dense(k_q, (L, c.dim, c.n_heads, hd), c.dim),
-            "wk": dense(k_k, (L, c.dim, c.n_kv_heads, hd), c.dim),
-            "wv": dense(k_v, (L, c.dim, c.n_kv_heads, hd), c.dim),
-            "wo": dense(k_o, (L, c.n_heads, hd, c.dim), c.n_heads * hd),
-            "mlp_norm": jnp.ones((L, c.dim), c.param_dtype),
+            **init_attn_params(c, (k_q, k_k, k_v, k_o), dense),
             # router stays float32: tiny, and routing is precision-
             # sensitive (standard MoE practice)
             "router": (
@@ -127,28 +118,24 @@ def init_params(rng: jax.Array, config: MoELlamaConfig) -> Dict[str, Any]:
 
 def param_count(config: MoELlamaConfig) -> int:
     c = config
-    attn = (
-        2 * c.dim
-        + c.dim * c.n_heads * c.head_dim
-        + 2 * c.dim * c.n_kv_heads * c.head_dim
-        + c.n_heads * c.head_dim * c.dim
-    )
     moe = c.dim * c.n_experts + 3 * c.n_experts * c.dim * c.ffn_dim
-    return c.vocab_size * c.dim * 2 + c.n_layers * (attn + moe) + c.dim
+    return (
+        c.vocab_size * c.dim * 2
+        + c.n_layers * (attn_param_count(c) + moe)
+        + c.dim
+    )
 
 
 def active_param_count(config: MoELlamaConfig) -> int:
     """Params touched per token (k of E experts) — the FLOPs-relevant
     count for MFU math on MoE models."""
     c = config
-    attn = (
-        2 * c.dim
-        + c.dim * c.n_heads * c.head_dim
-        + 2 * c.dim * c.n_kv_heads * c.head_dim
-        + c.n_heads * c.head_dim * c.dim
-    )
     moe = c.dim * c.n_experts + 3 * c.experts_per_token * c.dim * c.ffn_dim
-    return c.vocab_size * c.dim * 2 + c.n_layers * (attn + moe) + c.dim
+    return (
+        c.vocab_size * c.dim * 2
+        + c.n_layers * (attn_param_count(c) + moe)
+        + c.dim
+    )
 
 
 def block_fn(config: MoELlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
@@ -206,7 +193,16 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
 
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
             config: MoELlamaConfig) -> jax.Array:
-    """Next-token cross entropy + router load-balancing aux loss."""
+    """Next-token cross entropy + router load-balancing aux loss.
+
+    The LOSS mask ("mask") and the ROUTING mask are different things:
+    an SFT loss mask zeroes prompt positions whose tokens are still
+    real input the experts must process. Routing only excludes PADDING,
+    supplied as batch["input_mask"] aligned with the model inputs; when
+    absent, every input position routes."""
     inputs, targets, mask = unpack_batch(batch)
-    logits, aux = forward(params, inputs, config, mask=mask)
+    input_mask = batch.get("input_mask")
+    if input_mask is not None and "tokens" in batch:
+        input_mask = input_mask[:, :-1]  # align with inputs = tokens[:, :-1]
+    logits, aux = forward(params, inputs, config, mask=input_mask)
     return masked_ce(logits, targets, mask) + config.router_aux_coeff * aux

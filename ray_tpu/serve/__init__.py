@@ -198,12 +198,20 @@ def run(
     name: str = "default",
     route_prefix: Optional[str] = None,
     blocking: bool = False,
+    local_testing_mode: bool = False,
     _http: bool = False,
     http_options: Optional[Dict[str, Any]] = None,
 ) -> DeploymentHandle:
     """Deploy an application; returns the ingress deployment's handle
-    (reference: serve.run, api.py:591)."""
+    (reference: serve.run, api.py:591). ``local_testing_mode=True``
+    runs the whole app in-process with no cluster (reference:
+    serve/_private/local_testing_mode.py)."""
     import ray_tpu
+
+    if local_testing_mode:
+        from ._private.local_testing_mode import run_local
+
+        return run_local(app)  # type: ignore[return-value]
 
     controller = start(proxy=_http or route_prefix is not None, http_options=http_options)
     infos: Dict[str, DeploymentInfo] = {}

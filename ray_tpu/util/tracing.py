@@ -1,0 +1,140 @@
+"""Distributed tracing spans.
+
+Parity: python/ray/util/tracing/ — the reference hooks opentelemetry
+spans around API calls and ships them to a collector. Here spans are
+framework-native: a contextvar carries (trace_id, span_id) for
+nesting, finished spans batch to the hub over the client's existing
+connection, and they render in the same chrome-trace ``timeline()``
+as task events (cat="span"), so one Perfetto view shows user spans
+over the scheduler's task rows.
+
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    with tracing.span("preprocess", rows=1000):
+        ...
+    ctx = tracing.current_context()      # ship to another process
+    # in a task:  with tracing.context(ctx), tracing.span("stage2"): ...
+
+Enable globally with RAY_TPU_TRACING=1 (workers inherit the env).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+_enabled = os.environ.get("RAY_TPU_TRACING", "") in ("1", "true", "yes")
+# (trace_id, span_id) of the innermost open span
+_ctx: contextvars.ContextVar[Optional[Tuple[str, str]]] = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None
+)
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) to hand to another process (the reference
+    propagates the otel context in task metadata)."""
+    return _ctx.get()
+
+
+@contextlib.contextmanager
+def context(ctx: Optional[Tuple[str, str]]):
+    """Adopt a remote parent context for spans opened inside."""
+    token = _ctx.set(tuple(ctx) if ctx else None)
+    try:
+        yield
+    finally:
+        _ctx.reset(token)
+
+
+def _emit(record: Dict[str, Any]) -> None:
+    from ray_tpu._private import worker
+
+    if not worker.is_initialized():
+        return
+    try:
+        worker.get_client().send_async("span_record", record)
+    except Exception:
+        pass  # tracing must never take down the traced code
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any):
+    """Record a span around the block (no-op unless tracing is on)."""
+    if not _enabled:
+        yield None
+        return
+    parent = _ctx.get()
+    trace_id = parent[0] if parent else uuid.uuid4().hex[:16]
+    span_id = uuid.uuid4().hex[:16]
+    token = _ctx.set((trace_id, span_id))
+    start = time.time()
+    error: Optional[str] = None
+    try:
+        yield (trace_id, span_id)
+    except BaseException as exc:
+        error = type(exc).__name__
+        raise
+    finally:
+        _ctx.reset(token)
+        record = {
+            "name": name,
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_id": parent[1] if parent else None,
+            "start": start,
+            "end": time.time(),
+            "pid": os.getpid(),
+            "node_id": os.environ.get("RAY_TPU_NODE_ID", "head"),
+            "attrs": {k: str(v) for k, v in attrs.items()},
+        }
+        if error is not None:
+            record["attrs"]["error"] = error
+        _emit(record)
+
+
+def traced(name: Optional[str] = None):
+    """Decorator form: ``@tracing.traced()`` wraps calls in a span."""
+
+    def wrap(fn):
+        import functools
+
+        span_name = name or getattr(fn, "__qualname__", fn.__name__)
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with span(span_name):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap
+
+
+__all__ = [
+    "enable",
+    "disable",
+    "is_enabled",
+    "span",
+    "traced",
+    "current_context",
+    "context",
+]

@@ -126,3 +126,46 @@ def test_serve_streaming_response(serve_cleanup):
     h = serve.run(Tokens.bind())
     out = list(h.options(method_name="generate", stream=True).remote(4))
     assert out == ["tok0 ", "tok1 ", "tok2 ", "tok3 "]
+
+
+def test_tracing_spans_in_timeline(ray_start_regular):
+    """User spans (util/tracing) land in the chrome-trace timeline,
+    nested via trace/parent ids, including spans from workers
+    (reference: ray.util.tracing opentelemetry hook)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    tracing.enable()
+    try:
+        with tracing.span("outer", stage="fit") as outer_ctx:
+            with tracing.span("inner"):
+                time.sleep(0.01)
+            ctx = tracing.current_context()
+            assert ctx is not None and ctx[0] == outer_ctx[0]
+
+            @ray_tpu.remote
+            def work(parent_ctx):
+                from ray_tpu.util import tracing as t
+
+                t.enable()
+                with t.context(parent_ctx), t.span("remote-stage"):
+                    return 7
+
+            assert ray_tpu.get(work.remote(ctx)) == 7
+        deadline = time.monotonic() + 10
+        names = set()
+        while time.monotonic() < deadline:
+            events = ray_tpu.timeline()
+            names = {e["name"] for e in events if e.get("cat") == "span"}
+            if {"outer", "inner", "remote-stage"} <= names:
+                break
+            time.sleep(0.1)
+        assert {"outer", "inner", "remote-stage"} <= names, names
+        spans = {e["name"]: e for e in events if e.get("cat") == "span"}
+        assert spans["inner"]["args"]["parent_id"] == spans["outer"]["args"]["span_id"]
+        assert spans["remote-stage"]["args"]["trace_id"] == spans["outer"]["args"]["trace_id"]
+        assert spans["remote-stage"]["args"]["parent_id"] == spans["outer"]["args"]["span_id"]
+    finally:
+        tracing.disable()

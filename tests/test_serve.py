@@ -161,3 +161,88 @@ def test_http_ingress(serve_cleanup):
             time.sleep(0.3)
     assert isinstance(last, dict), last
     assert last == {"path": "/api/x", "method": "GET"}
+
+
+def test_local_testing_mode_no_cluster():
+    """serve.run(app, local_testing_mode=True) runs the whole app
+    in-process: no controller, no actors, composition + multiplexing +
+    streaming still behave (reference: serve local_testing_mode)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Embedder:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Head:
+        def __init__(self, embedder):
+            self.embedder = embedder
+
+        def __call__(self, x):
+            return self.embedder.remote(x).result() + 1
+
+        async def agen(self, n):
+            return [i for i in range(n)]
+
+        def stream(self, n):
+            for i in range(n):
+                yield i * 10
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, mid):
+            return mid.upper()
+
+        def which_model(self):
+            return self.get_model(serve.get_multiplexed_model_id())
+
+    h = serve.run(Head.bind(Embedder.bind()), local_testing_mode=True)
+    assert h.remote(10).result() == 21
+    assert h.agen.remote(3).result() == [0, 1, 2]
+    got = list(h.options(stream=True).stream.remote(3))
+    assert got == [0, 10, 20]
+    assert h.options(multiplexed_model_id="ma").which_model.remote().result() == "MA"
+    # errors surface at .result(), not submission
+    @serve.deployment
+    def boom():
+        raise ValueError("nope")
+
+    bh = serve.run(boom.bind(), local_testing_mode=True)
+    resp = bh.remote()
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="nope"):
+        resp.result()
+
+
+def test_local_testing_mode_async_callers():
+    """Local handles work from async code: `await resp` resolves lazy
+    coroutines; async generators stream natively."""
+    import asyncio
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class A:
+        async def compute(self, x):
+            await asyncio.sleep(0)
+            return x + 1
+
+        async def astream(self, n):
+            for i in range(n):
+                yield i * 2
+
+    h = serve.run(A.bind(), local_testing_mode=True)
+
+    async def drive():
+        v = await h.compute.remote(4)
+        items = []
+        async for item in h.options(stream=True).astream.remote(3):
+            items.append(item)
+        return v, items
+
+    v, items = asyncio.run(drive())
+    assert v == 5
+    assert items == [0, 2, 4]
+    # sync caller can also drain an async generator
+    assert list(h.options(stream=True).astream.remote(2)) == [0, 2]
