@@ -49,6 +49,12 @@ class CoreClient:
         self._send_lock = threading.Lock()
         self._send_buf: List[tuple] = []
         self._buf_evt = threading.Event()
+        # ownership-GC release ids, appended from ObjectRef.__del__.
+        # __del__ can run at ANY allocation point — including while THIS
+        # thread already holds _send_lock (GC during dumps_inline) — so
+        # the only safe operation there is a plain list.append (GIL-
+        # atomic, lock-free). The flusher thread drains it.
+        self._release_buf: List[bytes] = []
         self._req_counter = itertools.count()
         self._pending: Dict[int, Future] = {}
         self._pending_lock = threading.Lock()
@@ -101,14 +107,27 @@ class CoreClient:
 
     def flush(self) -> None:
         with self._send_lock:
+            if self._release_buf:
+                # swap-then-drain: concurrent __del__ appends land either
+                # in the drained list (sent now) or the fresh one (next
+                # flush) — nothing is lost, no lock needed on their side
+                drained = self._release_buf
+                self._release_buf = []
+                self._send_buf.append(
+                    ("release_owned", {"object_ids": drained})
+                )
             if self._send_buf:
                 buf, self._send_buf = self._send_buf, []
                 self.conn.send_bytes(dumps_inline(("batch", buf)))
 
     def _flush_loop(self) -> None:
         # Catches stray buffered messages ~0.5ms after the burst ends.
+        # The 50ms wait timeout doubles as the drain cadence for the
+        # lock-free release buffer (__del__ can't signal the event:
+        # Event.set takes a lock, and __del__ may preempt a thread that
+        # already holds it).
         while not self._closed:
-            self._buf_evt.wait()
+            self._buf_evt.wait(timeout=0.05)
             self._buf_evt.clear()
             time.sleep(0.0005)
             try:
@@ -336,6 +355,15 @@ class CoreClient:
             # drop any locally-fetched copy of a remote segment too
             self.store.free(o.hex())
         self.send_async(P.FREE, {"object_ids": [o.binary() for o in object_ids]})
+
+    def release_owned(self, oid: bytes) -> None:
+        """Owner dropped its last local handle to a never-shared ref:
+        the hub may free the object (ownership GC; reference analogue:
+        ReferenceCounter RemoveLocalReference -> eviction).
+
+        Called from ObjectRef.__del__ — must stay lock-free (plain
+        append only); the flusher thread ships the batch."""
+        self._release_buf.append(oid)
 
     # ----------------------------------------------------------------- tasks
     def register_function(self, fn_id: str, blob: bytes) -> None:

@@ -214,6 +214,7 @@ class Hub:
         host: str = "127.0.0.1",
         port: int = 0,
         object_store_memory: Optional[float] = None,
+        kv_store_path: Optional[str] = None,
     ):
         import socket as _socket
         import tempfile as _tempfile
@@ -279,7 +280,22 @@ class Hub:
         self.actors: Dict[bytes, ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}
         self.pgs: Dict[bytes, PGEntry] = {}
-        self.kv: Dict[bytes, bytes] = {}
+        # durable KV backend (reference: GCS StorageType in-memory vs
+        # redis — gcs_server.h; here an append-log + snapshot on the
+        # head's disk, _private/store.py). None = in-memory only.
+        from .store import open_store
+
+        # explicit argument wins over the machine-wide env default, and
+        # the store takes an exclusive flock so two hubs can't interleave
+        # appends into one log
+        self._kv_store = open_store(
+            kv_store_path or os.environ.get("RAY_TPU_KV_STORE_PATH"),
+            fsync=os.environ.get("RAY_TPU_KV_STORE_FSYNC", "")
+            in ("1", "true", "yes"),
+        )
+        self.kv: Dict[bytes, bytes] = (
+            self._kv_store.load() if self._kv_store else {}
+        )
         self.get_reqs: List[GetReq] = []
         self.obj_get_waiters: Dict[bytes, List[GetReq]] = {}
         self.obj_wait_waiters: Dict[bytes, List[WaitReq]] = {}
@@ -296,6 +312,11 @@ class Hub:
         # object_recovery_manager.h:43 re-executing the producing task)
         self._lineage: Dict[bytes, TaskSpec] = {}
         self._lineage_order: deque = deque()
+        # ownership GC: refs released before their producing task
+        # finished — freed the moment the value arrives. Insertion-
+        # ordered dict so the (rare) entries for ids that never
+        # materialize can be evicted oldest-first.
+        self._released_early: Dict[bytes, bool] = {}
         self._reconstruct_waiters: Dict[bytes, List[Tuple[Any, int]]] = {}
         self._reconstructing: Set[bytes] = set()
         self._ended_streams: deque = deque()  # consumed stream ids, FIFO
@@ -544,6 +565,10 @@ class Hub:
             if req.done:
                 continue
             self._check_wait(req)
+        # ownership GC: the owner released this ref before the value
+        # arrived — nothing can fetch it, free right away
+        if self._released_early.pop(oid, None):
+            self._free_ids([oid])
         self._dispatch()
 
     # ---- shm budget: LRU accounting + disk spill (reference: plasma
@@ -707,8 +732,33 @@ class Hub:
                     )
             self._add_timer(timeout, expire)
 
-    def _on_free(self, conn, p):
+    def _on_release_owned(self, conn, p):
+        """Ownership GC: the owner's last local handle died with the ref
+        never pickled, so no other holder can exist. Free immediately if
+        the value is ready; otherwise remember and free on arrival
+        (the producing task may still be running)."""
         for oid in p["object_ids"]:
+            e = self.objects.get(oid)
+            if e is None or not e.ready:
+                self._released_early[oid] = True
+                while len(self._released_early) > 100_000:
+                    self._released_early.pop(
+                        next(iter(self._released_early))
+                    )
+                continue
+            if (
+                self.obj_get_waiters.get(oid)
+                or self.obj_wait_waiters.get(oid)
+                or self.dep_waiters.get(oid)
+            ):
+                continue  # defensive: someone is mid-get; keep it
+            self._free_ids([oid])
+
+    def _on_free(self, conn, p):
+        self._free_ids(p["object_ids"])
+
+    def _free_ids(self, object_ids):
+        for oid in object_ids:
             e = self.objects.pop(oid, None)
             if e and e.kind == P.VAL_SHM:
                 self._drop_segment_accounting(oid, e)
@@ -958,11 +1008,14 @@ class Hub:
             ev = {"task_id": task_id.hex()}
             self._task_event_index[task_id] = ev
             self.task_events.append(ev)
-            if len(self._task_event_index) > self.task_events.maxlen:
-                # index follows the deque's eviction approximately
-                drop = len(self._task_event_index) - self.task_events.maxlen
-                for k in list(self._task_event_index)[:drop]:
-                    del self._task_event_index[k]
+            # dicts are insertion-ordered: evict oldest in O(1) per event
+            # (materializing the key list here was O(n) per TASK once the
+            # index filled — it halved actor-call throughput after 20k
+            # lifetime tasks)
+            while len(self._task_event_index) > self.task_events.maxlen:
+                self._task_event_index.pop(
+                    next(iter(self._task_event_index))
+                )
         ev.update(fields)
 
     # ----- pubsub (reference: src/ray/pubsub/publisher.h:300 — here a
@@ -1000,6 +1053,8 @@ class Hub:
             self._reply(conn, p["req_id"], ok=False)
             return
         self.kv[p["key"]] = p["value"]
+        if self._kv_store is not None:
+            self._kv_store.record_put(p["key"], p["value"])
         self._reply(conn, p["req_id"], ok=True)
 
     def _on_kv_get(self, conn, p):
@@ -1007,6 +1062,8 @@ class Hub:
 
     def _on_kv_del(self, conn, p):
         ok = self.kv.pop(p["key"], None) is not None
+        if ok and self._kv_store is not None:
+            self._kv_store.record_del(p["key"])
         self._reply(conn, p["req_id"], ok=ok)
 
     def _on_kv_keys(self, conn, p):
@@ -2145,3 +2202,5 @@ class Hub:
                         w.proc.kill()
                     except Exception:
                         pass
+        if self._kv_store is not None:
+            self._kv_store.close()

@@ -145,6 +145,7 @@ def init(
             tcp=bool(kwargs.get("_tcp_hub") or os.environ.get("RAY_TPU_TCP_HUB")),
             host=kwargs.get("_hub_host", "127.0.0.1"),
             port=int(kwargs.get("_hub_port", 0)),
+            kv_store_path=kwargs.get("_kv_store_path"),
             object_store_memory=object_store_memory,
         )
         _hub.start()
@@ -239,7 +240,7 @@ def put(value: Any) -> ObjectRef:
         raise TypeError("Calling put() on an ObjectRef is not allowed.")
     client = get_client()
     oid = client.put_value(value)
-    return ObjectRef(oid)
+    return ObjectRef(oid, _owned=True)
 
 
 def get(

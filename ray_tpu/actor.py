@@ -69,7 +69,7 @@ class ActorMethod:
             num_returns,
             options,
         )
-        refs = [ObjectRef(r) for r in return_ids]
+        refs = [ObjectRef(r, _owned=True) for r in return_ids]
         return refs[0] if num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):

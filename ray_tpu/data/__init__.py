@@ -21,6 +21,7 @@ from .dataset import (
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
@@ -34,8 +35,8 @@ __all__ = [
     "BlockMetadata", "Count", "DataContext", "Dataset", "Datasource",
     "GroupedData", "Max", "Mean", "Min", "ReadTask", "Std", "Sum",
     "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
-    "read_binary_files", "read_csv", "read_datasource", "read_json",
-    "read_numpy", "read_parquet", "read_text",
+    "read_binary_files", "read_csv", "read_datasource", "read_images",
+    "read_json", "read_numpy", "read_parquet", "read_text",
 ]
 
 from ray_tpu._private import usage as _usage

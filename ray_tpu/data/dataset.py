@@ -562,3 +562,35 @@ def read_numpy(paths, *, parallelism: int = -1, **_kw) -> Dataset:
             return {"data": np.load(path)}
 
     return read_datasource(NpyDatasource(paths), parallelism=parallelism)
+
+
+def read_images(
+    paths,
+    *,
+    size: Optional[Tuple[int, int]] = None,
+    mode: Optional[str] = None,
+    include_paths: bool = False,
+    parallelism: int = -1,
+    **_kw,
+) -> Dataset:
+    """Decode image files into an "image" column of HWC uint8 arrays
+    (reference: data/datasource/image_datasource.py read_images — size/
+    mode resize+convert on read so downstream batches are rectangular)."""
+    from .datasource import FileBasedDatasource
+
+    class ImageDatasource(FileBasedDatasource):
+        def _read_file(self, path: str) -> Block:
+            from PIL import Image
+
+            with Image.open(path) as im:
+                if mode is not None:
+                    im = im.convert(mode)
+                if size is not None:
+                    im = im.resize((size[1], size[0]))  # PIL takes (W, H)
+                arr = np.asarray(im)
+            row = {"image": arr}
+            if include_paths:
+                row["path"] = path
+            return [row]
+
+    return read_datasource(ImageDatasource(paths), parallelism=parallelism)

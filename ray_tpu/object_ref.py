@@ -16,10 +16,19 @@ from ._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("_id", "__weakref__")
+    __slots__ = ("_id", "_owned", "_shared", "__weakref__")
 
-    def __init__(self, object_id: ObjectID):
+    def __init__(self, object_id: ObjectID, *, _owned: bool = False):
         self._id = object_id
+        # Ownership GC (simplified form of the reference's
+        # ReferenceCounter, reference_count.h:43): a ref created by this
+        # process's own put()/task submission is "owned"; when the LAST
+        # local handle to an owned, never-pickled ref dies, the hub
+        # frees the object. Pickling makes borrowers possible, so a
+        # shared ref is never auto-freed (it leaks like pre-GC — the
+        # conservative direction).
+        self._owned = _owned
+        self._shared = False
 
     def binary(self) -> bytes:
         return self._id.binary()
@@ -37,7 +46,20 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
+        self._shared = True  # a copy may now exist anywhere: never auto-free
         return (_rebuild_ref, (self._id.binary(),))
+
+    def __del__(self):
+        if not getattr(self, "_owned", False) or getattr(self, "_shared", True):
+            return
+        try:
+            from ._private import worker
+
+            client = worker._client
+            if client is not None and not client._closed:
+                client.release_owned(self._id.binary())
+        except Exception:
+            pass  # interpreter teardown / connection already gone
 
     # -- convenience -----------------------------------------------------
     def get(self, timeout: Optional[float] = None) -> Any:

@@ -224,7 +224,7 @@ class RemoteFunction:
         return_ids = client.submit_task(
             fn_id, args_kind, args_payload, deps, num_returns, resources, options
         )
-        refs = [ObjectRef(r) for r in return_ids]
+        refs = [ObjectRef(r, _owned=True) for r in return_ids]
         if num_returns == 1:
             return refs[0]
         return refs

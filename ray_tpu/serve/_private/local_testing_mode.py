@@ -75,11 +75,16 @@ class _LocalResponseGenerator:
     async callers (the real replica supports both — replica.py
     handle_request_streaming)."""
 
-    def __init__(self, gen=None, agen=None):
+    def __init__(self, gen=None, agen=None, coro=None):
         self._gen = gen
         self._agen = agen
+        self._coro = coro  # plain async method under stream=True
 
     def __iter__(self):
+        if self._coro is not None:
+            coro, self._coro = self._coro, None
+            yield asyncio.run(coro)
+            return
         if self._agen is not None:
             async def _drain(agen=self._agen):
                 return [item async for item in agen]
@@ -89,6 +94,10 @@ class _LocalResponseGenerator:
         yield from self._gen
 
     async def __aiter__(self):
+        if self._coro is not None:
+            coro, self._coro = self._coro, None
+            yield await coro
+            return
         if self._agen is not None:
             async for item in self._agen:
                 yield item
@@ -148,10 +157,10 @@ class _LocalHandle:
                 if inspect.isgenerator(result):
                     return _LocalResponseGenerator(gen=result)
                 if inspect.iscoroutine(result):
-                    # a coroutine returning one value: one-item stream
-                    return _LocalResponseGenerator(
-                        gen=iter([_LocalResponse(coro=result).result()])
-                    )
+                    # one-item stream, resolved lazily at iteration so
+                    # errors surface at consumption and async callers
+                    # can drive it on their own loop
+                    return _LocalResponseGenerator(coro=result)
                 return _LocalResponseGenerator(gen=iter([result]))
             if inspect.iscoroutine(result):
                 # body runs later (at await/result): re-enter the model

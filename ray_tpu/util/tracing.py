@@ -116,8 +116,20 @@ def traced(name: Optional[str] = None):
 
     def wrap(fn):
         import functools
+        import inspect
 
         span_name = name or getattr(fn, "__qualname__", fn.__name__)
+
+        if inspect.iscoroutinefunction(fn):
+            # the span must cover the awaited body, not the instant
+            # coroutine construction — and the context must be live
+            # while the body executes so child spans parent correctly
+            @functools.wraps(fn)
+            async def ainner(*args, **kwargs):
+                with span(span_name):
+                    return await fn(*args, **kwargs)
+
+            return ainner
 
         @functools.wraps(fn)
         def inner(*args, **kwargs):

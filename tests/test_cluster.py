@@ -166,3 +166,78 @@ def test_lineage_reconstruction_after_node_death(cluster):
     cluster.add_node(num_cpus=2, resources={"ephemeral": 4.0})
     arr = ray_tpu.get(ref, timeout=60)  # fetch fails -> reconstructs
     assert float(arr[123_456]) == 123_456.0
+
+
+def test_kv_survives_head_restart(tmp_path, shutdown_only):
+    """Durable KV backend: the internal KV (function table, Serve/Tune
+    metadata analogue) survives a head restart (reference: GCS fault
+    tolerance with a Redis store, tests/test_gcs_fault_tolerance.py)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()  # a prior test may have left a runtime up
+    store = str(tmp_path / "gcs_store")
+    ray_tpu.init(num_cpus=1, _kv_store_path=store)
+    client = ray_tpu._private.worker.get_client()
+    client.kv_put(b"durable_key", b"v1")
+    client.kv_put(b"temp_key", b"x")
+    client.kv_del(b"temp_key")
+    client.kv_put(b"durable_key2", b"v2", overwrite=True)
+    ray_tpu.shutdown()
+
+    # "restarted head": fresh hub pointed at the same store
+    ray_tpu.init(num_cpus=1, _kv_store_path=store)
+    client = ray_tpu._private.worker.get_client()
+    assert client.kv_get(b"durable_key") == b"v1"
+    assert client.kv_get(b"durable_key2") == b"v2"
+    assert client.kv_get(b"temp_key") is None
+    # mutations after recovery persist too (log reopened post-compact)
+    client.kv_put(b"durable_key3", b"v3")
+    ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=1, _kv_store_path=store)
+    client = ray_tpu._private.worker.get_client()
+    assert client.kv_get(b"durable_key3") == b"v3"
+
+
+def test_kv_store_tolerates_torn_log_tail(tmp_path, shutdown_only):
+    """A crash mid-append leaves a torn record; recovery drops it and
+    keeps everything before it."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    store = str(tmp_path / "gcs_store")
+    ray_tpu.init(num_cpus=1, _kv_store_path=store)
+    client = ray_tpu._private.worker.get_client()
+    client.kv_put(b"a", b"1")
+    client.kv_put(b"b", b"2")
+    ray_tpu.shutdown()
+
+    import os
+
+    log = os.path.join(store, "kv.log")
+    # simulate crash: append garbage half-record
+    with open(log, "ab") as f:
+        f.write(b"\xff\xff\xff\x7f partial")
+
+    ray_tpu.init(num_cpus=1, _kv_store_path=store)
+    client = ray_tpu._private.worker.get_client()
+    assert client.kv_get(b"a") == b"1"
+    assert client.kv_get(b"b") == b"2"
+
+
+def test_kv_store_exclusive_lock(tmp_path):
+    """Two hubs must not share one durable store (the second would
+    truncate the first's log)."""
+    from ray_tpu._private.store import FileKvStore
+
+    store = str(tmp_path / "locked_store")
+    first = FileKvStore(store)
+    first.load()
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="already owned"):
+        FileKvStore(store)
+    first.close()
+    second = FileKvStore(store)  # released lock: reopenable
+    second.load()
+    second.close()

@@ -277,3 +277,24 @@ class TestPushBasedShuffle:
         assert len(calls) == 16
         # 16 maps -> rounds of 4 -> 4 partials per partition
         assert all(len(p) == 4 for p in parts)
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    """read_images: decode + resize/convert on read (reference:
+    image_datasource.py)."""
+    import numpy as np
+    from PIL import Image
+
+    import ray_tpu.data as rd
+
+    for i in range(3):
+        arr = np.full((10 + i, 12, 3), i * 40, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.png")
+
+    ds = rd.read_images(str(tmp_path), size=(8, 8), mode="RGB", include_paths=True)
+    rows = list(ds.iter_rows())
+    assert len(rows) == 3
+    for r in rows:
+        assert r["image"].shape == (8, 8, 3)
+        assert r["image"].dtype == np.uint8
+        assert r["path"].endswith(".png")
