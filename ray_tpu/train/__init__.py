@@ -17,6 +17,7 @@ from ._checkpoint import Checkpoint
 from .backend import Backend, BackendConfig, JaxConfig
 from .data_parallel_trainer import DataParallelTrainer, TrainingFailedError
 from .jax_trainer import JaxTrainer
+from .torch_trainer import TorchConfig, TorchTrainer
 from .session import (
     TrainContext,
     get_checkpoint,
@@ -34,6 +35,8 @@ __all__ = [
     "FailureConfig",
     "JaxConfig",
     "JaxTrainer",
+    "TorchConfig",
+    "TorchTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",

@@ -77,6 +77,23 @@ def _jax_worker_setup(
     return True
 
 
+def rank0_rendezvous_addr(worker_group, port: int = 0) -> str:
+    """host:port every rank can dial, bound on rank 0's host (shared by
+    the JAX and Torch backends — the master-addr/port pattern of the
+    reference's _TorchBackend, train/torch/config.py:66).
+
+    node_ip, not hostname: simulated hosts have fake hostnames, and
+    real pods may not resolve each other's names — the IP the agent
+    registered with is what peers can dial."""
+    import ray_tpu
+
+    rank0 = worker_group.workers[0]
+    if not port:
+        port = ray_tpu.get(rank0.actor.pick_free_port.remote())
+    ip = rank0.metadata.get("node_ip") or rank0.metadata["hostname"]
+    return f"{ip}:{port}"
+
+
 class _JaxBackend(Backend):
     def on_start(self, worker_group, backend_config: JaxConfig) -> None:
         n = len(worker_group.workers)
@@ -89,15 +106,9 @@ class _JaxBackend(Backend):
             return
         import ray_tpu
 
-        rank0 = worker_group.workers[0]
-        port = backend_config.coordinator_port
-        if not port:
-            port = ray_tpu.get(rank0.actor.pick_free_port.remote())
-        # node_ip, not hostname: simulated hosts have fake hostnames, and
-        # real pods may not resolve each other's names — the IP the agent
-        # registered with is what peers can dial.
-        ip = rank0.metadata.get("node_ip") or rank0.metadata["hostname"]
-        addr = f"{ip}:{port}"
+        addr = rank0_rendezvous_addr(
+            worker_group, backend_config.coordinator_port
+        )
         refs = [
             w.actor.run_backend_hook.remote(
                 _jax_worker_setup, addr, n, w.rank

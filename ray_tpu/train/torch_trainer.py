@@ -1,0 +1,127 @@
+"""TorchTrainer: torch.distributed (gloo) data-parallel training.
+
+Parity: python/ray/train/torch/torch_trainer.py + config.py:36,153
+(_TorchBackend — pick worker-0 addr/port, dist.init_process_group on
+every worker). On this framework torch is the CPU-side companion to
+the JAX/TPU path: the gang is the same placement-group worker group the
+JaxTrainer uses; only the rendezvous differs (torch needs a process
+group even for a single-host gang, since every rank is its own
+process — unlike JAX's single-controller model).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from ..air.config import RunConfig, ScalingConfig
+from ._checkpoint import Checkpoint
+from .backend import Backend, BackendConfig
+from .data_parallel_trainer import DataParallelTrainer
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    """reference: train/torch/config.py TorchConfig (backend/timeout)."""
+
+    backend: str = "gloo"  # CPU collectives; nccl has no TPU meaning
+    init_timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return _TorchBackend
+
+
+def _torch_worker_setup(worker, addr: str, world_size: int, rank: int,
+                        backend: str, timeout_s: float):
+    """Runs inside each TrainWorker actor (the reference's
+    _setup_torch_process_group, config.py:66)."""
+    import datetime
+
+    import torch.distributed as dist
+
+    if not dist.is_initialized():
+        dist.init_process_group(
+            backend,
+            init_method=f"tcp://{addr}",
+            world_size=world_size,
+            rank=rank,
+            timeout=datetime.timedelta(seconds=timeout_s),
+        )
+    return True
+
+
+def _torch_worker_teardown(worker):
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    return True
+
+
+class _TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: TorchConfig) -> None:
+        # group inits even for n == 1: world-size-agnostic loops call
+        # dist.get_world_size()/all_reduce unconditionally (reference
+        # behavior — _TorchBackend always sets up the process group)
+        n = len(worker_group.workers)
+        import ray_tpu
+
+        from .backend import rank0_rendezvous_addr
+
+        addr = rank0_rendezvous_addr(worker_group)
+        ray_tpu.get([
+            w.actor.run_backend_hook.remote(
+                _torch_worker_setup, addr, n, w.rank,
+                backend_config.backend, backend_config.init_timeout_s,
+            )
+            for w in worker_group.workers
+        ])
+
+    def on_shutdown(self, worker_group, backend_config: TorchConfig) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.get([
+                w.actor.run_backend_hook.remote(_torch_worker_teardown)
+                for w in worker_group.workers
+            ])
+        except Exception:
+            pass  # workers may already be dead (gang teardown)
+
+
+class TorchTrainer(DataParallelTrainer):
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        torch_config: Optional[TorchConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        metadata: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=torch_config or TorchConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint,
+            metadata=metadata,
+        )
+
+
+def prepare_model(model):
+    """Wrap in DDP when a process group is live (reference:
+    ray.train.torch.prepare_model, minus device movement — CPU gloo)."""
+    import torch.distributed as dist
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
