@@ -56,6 +56,17 @@ class MappedSegment:
             os.close(fd)
         self.size = st.st_size
 
+    @classmethod
+    def from_fd(cls, path: str, fd: int, size: int) -> "MappedSegment":
+        """Map the WRITER'S OWN fd (before close): re-opening by path
+        could observe a concurrent rewriter's fresh, incomplete file
+        (speculative task retry of the same object id)."""
+        seg = cls.__new__(cls)
+        seg.path = path
+        seg.mm = mmap.mmap(fd, size)
+        seg.size = size
+        return seg
+
 
 def _write_all(fd: int, data) -> None:
     """write() can return short (and caps at ~2 GiB per call) — loop."""
@@ -126,10 +137,11 @@ class ShmObjectStore:
             if parts:
                 _write_all(fd, b"".join(parts))
             size = pos
+            seg = MappedSegment.from_fd(path, fd, size)
         finally:
             os.close(fd)
         with self._lock:
-            self._segments[name] = MappedSegment(path)
+            self._segments[name] = seg
         return size
 
     def get(self, name: str) -> Any:

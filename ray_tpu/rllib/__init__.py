@@ -12,6 +12,7 @@ from .core import MLPSpec, forward, init_mlp_module, sample_actions
 from .env_runner import SingleAgentEnvRunner
 from .dqn import DQN, DQNConfig
 from .impala import IMPALA, IMPALAConfig, vtrace
+from .marwil import MARWIL, MARWILConfig
 from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .ppo import PPOConfig
 from .sac import SAC, SACConfig
@@ -26,6 +27,8 @@ __all__ = [
     "DQNConfig",
     "IMPALA",
     "IMPALAConfig",
+    "MARWIL",
+    "MARWILConfig",
     "MLPSpec",
     "PPOConfig",
     "SAC",

@@ -1,0 +1,127 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning.
+
+Parity: python/ray/rllib/algorithms/marwil/ — offline learning from a
+Dataset of (obs, actions, returns): a value head estimates V(s), and
+the policy is cloned with per-sample weights exp(beta * advantage /
+norm), so high-return actions dominate (beta=0 degenerates to BC —
+same equivalence the reference documents). The advantage normalizer is
+the running mean of squared advantages (the paper's c^2 estimate).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import MLPSpec, forward, init_mlp_module
+
+
+@dataclass
+class MARWILConfig:
+    lr: float = 1e-3
+    beta: float = 1.0  # 0 = plain BC
+    vf_coeff: float = 1.0
+    moving_average_sqd_adv_norm_update_rate: float = 1e-2  # reference knob
+    train_batch_size: int = 256
+    hiddens: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def training(self, **kwargs) -> "MARWILConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown MARWIL training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build_algo(self, obs_dim: int, num_actions: int) -> "MARWIL":
+        return MARWIL(self, obs_dim, num_actions)
+
+
+class MARWIL:
+    def __init__(self, config: MARWILConfig, obs_dim: int, num_actions: int):
+        import optax
+
+        self.config = config
+        self.spec = MLPSpec(obs_dim, num_actions, tuple(config.hiddens))
+        self.params = init_mlp_module(
+            jax.random.PRNGKey(config.seed), self.spec
+        )
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        # moving average of squared advantages (weight normalizer)
+        self.ma_sqd_adv = jnp.asarray(1.0, jnp.float32)
+        beta = config.beta
+        vf_coeff = config.vf_coeff
+        rate = config.moving_average_sqd_adv_norm_update_rate
+
+        def loss_fn(params, ma_sqd_adv, obs, actions, returns):
+            logits, values = forward(params, obs)
+            adv = returns - values
+            # update the normalizer OUTSIDE the gradient
+            adv_sg = jax.lax.stop_gradient(adv)
+            new_ma = ma_sqd_adv + rate * (jnp.mean(adv_sg**2) - ma_sqd_adv)
+            weights = jnp.exp(
+                beta * adv_sg / jnp.sqrt(jnp.maximum(new_ma, 1e-8))
+            )
+            # clip for stability (reference clamps the exponent's output)
+            weights = jnp.minimum(weights, 20.0)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, actions[:, None], axis=1)[:, 0]
+            pi_loss = jnp.mean(jax.lax.stop_gradient(weights) * nll)
+            vf_loss = jnp.mean(adv**2)
+            return pi_loss + vf_coeff * vf_loss, (new_ma, pi_loss, vf_loss)
+
+        @jax.jit
+        def update(params, opt_state, ma_sqd_adv, obs, actions, returns):
+            (loss, (new_ma, pi_loss, vf_loss)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, ma_sqd_adv, obs, actions, returns)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_ma, loss, pi_loss, vf_loss
+
+        self._update = update
+        self.iteration = 0
+
+    def train_on_dataset(self, dataset, *, epochs: int = 1) -> Dict[str, Any]:
+        """Offline pass(es) over a Dataset with "obs", "actions" and
+        "returns" columns (rllib/offline shape + MC returns)."""
+        losses = []
+        n = 0
+        for _ in range(epochs):
+            for batch in dataset.iter_batches(
+                batch_size=self.config.train_batch_size, batch_format="numpy"
+            ):
+                actions = np.asarray(batch["actions"], np.int64)
+                obs = np.asarray(batch["obs"], np.float32).reshape(
+                    len(actions), -1
+                )
+                returns = np.asarray(batch["returns"], np.float32)
+                (
+                    self.params,
+                    self.opt_state,
+                    self.ma_sqd_adv,
+                    loss,
+                    _pi,
+                    _vf,
+                ) = self._update(
+                    self.params, self.opt_state, self.ma_sqd_adv,
+                    obs, actions, returns,
+                )
+                losses.append(float(loss))
+                n += len(actions)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "num_samples_trained": n,
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "ma_sqd_adv": float(self.ma_sqd_adv),
+        }
+
+    def compute_single_action(self, obs) -> int:
+        logits, _ = forward(self.params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(logits[0]))

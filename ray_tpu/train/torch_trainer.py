@@ -16,7 +16,7 @@ from typing import Any, Callable, Dict, Optional
 
 from ..air.config import RunConfig, ScalingConfig
 from ._checkpoint import Checkpoint
-from .backend import Backend, BackendConfig
+from .backend import Backend, BackendConfig, rank0_rendezvous_addr
 from .data_parallel_trainer import DataParallelTrainer
 
 
@@ -66,8 +66,6 @@ class _TorchBackend(Backend):
         # behavior — _TorchBackend always sets up the process group)
         n = len(worker_group.workers)
         import ray_tpu
-
-        from .backend import rank0_rendezvous_addr
 
         addr = rank0_rendezvous_addr(worker_group)
         ray_tpu.get([
