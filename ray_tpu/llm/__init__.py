@@ -8,6 +8,7 @@ config.LLMConfig.placement_bundles)."""
 from ._internal.engine import GenRequest, LlamaEngine
 from .batch import build_llm_processor
 from .config import LLMConfig, save_params_npz
+from .lora import apply_lora, load_lora_adapter
 from .serve import LLMServer, build_llm_app
 
 __all__ = [
@@ -15,8 +16,10 @@ __all__ = [
     "LLMConfig",
     "LLMServer",
     "LlamaEngine",
+    "apply_lora",
     "build_llm_app",
     "build_llm_processor",
+    "load_lora_adapter",
     "save_params_npz",
 ]
 

@@ -33,6 +33,7 @@ class GenRequest:
     max_tokens: int = 64
     temperature: float = 0.0
     eos_id: Optional[int] = None
+    adapter_id: str = ""  # LoRA adapter ("" = base model)
     # filled during generation
     slot: int = -1
     generated: List[int] = field(default_factory=list)

@@ -18,6 +18,8 @@ from typing import Any, Dict, List, Optional, Tuple
 class LLMConfig:
     """Declarative model+engine config for serving / batch inference."""
 
+    model_id: str = "base"            # name openai-style bodies use for
+    # the base model ({"model": model_id} routes to base, not a LoRA)
     model_config: Any = None          # ray_tpu.models.llama.LlamaConfig
     checkpoint_path: Optional[str] = None  # orbax/npz dir; None = random init
     tensor_parallel_size: int = 1
@@ -27,6 +29,10 @@ class LLMConfig:
     accelerator_type: str = "TPU"
     # engine extras (temperature defaults etc.)
     engine_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # LoRA multiplexing (reference: ray.llm LoraConfig):
+    #   {"dynamic_lora_loading_path": dir with <adapter_id>.npz,
+    #    "max_adapters_per_replica": 4, "scale": 1.0}
+    lora_config: Optional[Dict[str, Any]] = None
 
     def placement_bundles(self) -> Tuple[List[Dict[str, float]], str]:
         """(bundles, strategy): one bundle of tp chips per pp rank.

@@ -1,0 +1,91 @@
+"""LoRA adapters for served models.
+
+Parity: python/ray/llm LoRA multiplexing (serve deployments load
+adapters on demand from `dynamic_lora_loading_path` and route requests
+by adapter id through serve's model multiplexing). TPU-native
+difference: adapters are FOLDED into the weights at load time
+(W' = W + scale * A@B) and the folded model runs as its own engine —
+XLA recompiles nothing (same shapes), decode batches stay uniform, and
+the fold is one einsum per adapted matrix at load.
+
+Adapter file format (.npz): for each adapted parameter, either
+  "<path>.delta"            full-shape delta tensor, or
+  "<path>.A" + "<path>.B"   factored (prod(leading_dims), r) x (r, last)
+with "<path>" the '/'-joined pytree path (e.g. "blocks/wq",
+"lm_head"). Optional scalar "scale" overrides the caller's scale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+def load_lora_adapter(path: str) -> Dict[str, np.ndarray]:
+    """Read an adapter .npz into {key: array}."""
+    return dict(np.load(path))
+
+
+def _flatten(params, prefix=""):
+    out = {}
+    if isinstance(params, dict):
+        for k, v in params.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = params
+    return out
+
+
+def apply_lora(params: Dict[str, Any], adapter: Dict[str, np.ndarray],
+               scale: float = 1.0) -> Dict[str, Any]:
+    """Fold an adapter into a COPY of params (unadapted leaves are
+    shared, not copied)."""
+    import jax.numpy as jnp
+
+    if "scale" in adapter:
+        scale = float(adapter["scale"])
+    # group adapter entries by target path
+    deltas: Dict[str, Any] = {}
+    for key, arr in adapter.items():
+        if key == "scale":
+            continue
+        if key.endswith(".delta"):
+            deltas[key[:-6]] = ("delta", arr)
+        elif key.endswith(".A"):
+            path = key[:-2]
+            b = adapter.get(path + ".B")
+            if b is None:
+                raise ValueError(f"adapter has {key} but no {path}.B")
+            deltas[path] = ("ab", arr, b)
+        elif key.endswith(".B"):
+            if adapter.get(key[:-2] + ".A") is None:
+                raise ValueError(f"adapter has {key} but no {key[:-2]}.A")
+        else:
+            raise ValueError(
+                f"unrecognized adapter entry {key!r} "
+                "(expected <path>.delta or <path>.A/.B)"
+            )
+
+    flat = _flatten(params)
+    for path in deltas:
+        if path not in flat:
+            raise ValueError(
+                f"adapter targets unknown parameter {path!r}; "
+                f"known: {sorted(flat)[:8]}..."
+            )
+
+    def fold(node, prefix=""):
+        if isinstance(node, dict):
+            return {k: fold(v, f"{prefix}{k}/") for k, v in node.items()}
+        path = prefix[:-1]
+        spec = deltas.get(path)
+        if spec is None:
+            return node  # shared leaf, no copy
+        if spec[0] == "delta":
+            return node + scale * jnp.asarray(spec[1], node.dtype)
+        _, a, b = spec
+        delta = (jnp.asarray(a, jnp.float32) @ jnp.asarray(b, jnp.float32))
+        return node + scale * delta.reshape(node.shape).astype(node.dtype)
+
+    return fold(params)

@@ -33,13 +33,30 @@ class LLMServer:
 
         from ray_tpu.models import llama
 
+        self._base_params = params
+        self._model_config = llm_config.model_config or llama.LLAMA_TINY
         self.engine = LlamaEngine(
-            llm_config.model_config or llama.LLAMA_TINY,
+            self._model_config,
             params,
             max_batch=llm_config.max_batch_size,
             max_seq=llm_config.max_seq_len,
             **llm_config.engine_kwargs,
         )
+        # LoRA multiplexing: adapter id -> folded-weights engine, LRU-
+        # capped (never evicting active engines — which is why this is
+        # a hand-rolled cache rather than @serve.multiplexed); loaded
+        # ids ride the serve multiplex registry so the router prefers
+        # replicas already holding an adapter
+        from collections import OrderedDict
+
+        self._engines: "OrderedDict[str, LlamaEngine]" = OrderedDict()
+        self._engines[""] = self.engine
+        self._engines_lock = threading.Lock()
+        self._reporter = None
+        if llm_config.lora_config:
+            from ray_tpu.serve.multiplex import register_model_reporter
+
+            self._reporter = register_model_reporter(self._loaded_adapters)
         self._pending: "queue.Queue" = queue.Queue()
         self._id_counter = itertools.count()
         self._token_queues: Dict[str, "queue.Queue"] = {}
@@ -50,19 +67,110 @@ class LLMServer:
         )
         self._loop_thread.start()
 
+    # -- LoRA engines --------------------------------------------------
+    def _loaded_adapters(self):
+        with self._engines_lock:
+            return [aid for aid in self._engines if aid]
+
+    def shutdown(self) -> None:
+        """Stop the batching loop and drop the multiplex registration
+        (a torn-down replica must not pin engines or report stale ids)."""
+        self._running = False
+        if self._reporter is not None:
+            from ray_tpu.serve.multiplex import unregister_model_reporter
+
+            unregister_model_reporter(self._reporter)
+            self._reporter = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+    def _engine_for(self, adapter_id: str):
+        """Engine serving this adapter, loading + folding on first use
+        (LRU-capped per lora_config.max_adapters_per_replica)."""
+        with self._engines_lock:
+            eng = self._engines.get(adapter_id)
+            if eng is not None:
+                self._engines.move_to_end(adapter_id)
+                return eng
+        lora = self.config.lora_config
+        if not lora:
+            raise ValueError(
+                f"request for adapter {adapter_id!r} but no lora_config"
+            )
+        import os
+
+        if (
+            not adapter_id
+            or "/" in adapter_id
+            or "\\" in adapter_id
+            or ".." in adapter_id
+        ):
+            # the id comes from request bodies: it must never be able to
+            # escape dynamic_lora_loading_path
+            raise ValueError(f"invalid adapter id {adapter_id!r}")
+
+        from ._internal.engine import LlamaEngine
+        from .lora import apply_lora, load_lora_adapter
+
+        base = lora["dynamic_lora_loading_path"]
+        path = (
+            base.format(adapter_id)
+            if "{}" in base
+            else os.path.join(base, adapter_id + ".npz")
+        )
+        folded = apply_lora(
+            self._base_params,
+            load_lora_adapter(path),
+            scale=float(lora.get("scale", 1.0)),
+        )
+        eng = LlamaEngine(
+            self._model_config,
+            folded,
+            max_batch=self.config.max_batch_size,
+            max_seq=self.config.max_seq_len,
+            **self.config.engine_kwargs,
+        )
+        cap = int(lora.get("max_adapters_per_replica", 4))
+        with self._engines_lock:
+            self._engines[adapter_id] = eng
+            # LRU-evict idle adapters past the cap — never the base "",
+            # never an engine mid-generation, never the one just loaded
+            evictable = [
+                aid for aid in self._engines
+                if aid and aid != adapter_id
+                and not self._engines[aid].num_active()
+            ]
+            while len(self._engines) - 1 > cap and evictable:
+                del self._engines[evictable.pop(0)]
+        return eng
+
     # -- continuous batching loop -------------------------------------
     def _batching_loop(self):
         while self._running:
-            # admit as many pending requests as there are free slots
+            # admit as many pending requests as their engines have slots
             admitted = False
-            while self.engine.has_capacity():
+            requeue = []
+            while True:
                 try:
                     req = self._pending.get_nowait()
                 except queue.Empty:
                     break
                 q = self._token_queues.get(req.request_id)
                 try:
-                    self.engine.add_request(req)
+                    eng = self._engine_for(req.adapter_id)
+                except Exception as e:
+                    if q is not None:
+                        q.put(("error", e))
+                    continue
+                if not eng.has_capacity():
+                    requeue.append(req)
+                    continue
+                try:
+                    eng.add_request(req)
                 except Exception as e:
                     # a bad request (e.g. prompt >= max_seq) must fail
                     # its own caller, never the batching thread
@@ -75,17 +183,25 @@ class LLMServer:
                     q.put(("token", req.generated[0]))
                     if req.done:
                         q.put(("done", None))
-            if self.engine.num_active():
+            for req in requeue:
+                self._pending.put(req)
+            stepped = False
+            with self._engines_lock:
+                live_engines = list(self._engines.values())
+            for eng in live_engines:
+                if not eng.num_active():
+                    continue
+                stepped = True
                 try:
-                    emitted = self.engine.step()
+                    emitted = eng.step()
                 except Exception as e:
                     # engine fault: fail every active request, keep serving
-                    for slot in list(self.engine.active):
-                        req = self.engine.active[slot]
+                    for slot in list(eng.active):
+                        req = eng.active[slot]
                         q = self._token_queues.get(req.request_id)
                         if q is not None:
                             q.put(("error", e))
-                        self.engine._finish(slot)
+                        eng._finish(slot)
                     continue
                 for req, tok in emitted:
                     q = self._token_queues.get(req.request_id)
@@ -93,7 +209,7 @@ class LLMServer:
                         q.put(("token", tok))
                         if req.done:
                             q.put(("done", None))
-            elif not admitted:
+            if not stepped and not admitted:
                 time.sleep(0.005)
 
     # -- request entrypoints ------------------------------------------
@@ -103,11 +219,17 @@ class LLMServer:
         max_tokens: int = 64,
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
+        adapter_id: Optional[str] = None,
     ):
         """Generator: yields token ids as the engine produces them
         (invoked through serve's streaming path)."""
         from ._internal.engine import GenRequest
 
+        if adapter_id is None:
+            # serve routing: handle.options(multiplexed_model_id=...)
+            from ray_tpu.serve import get_multiplexed_model_id
+
+            adapter_id = get_multiplexed_model_id()
         rid = f"req{next(self._id_counter)}"
         q: "queue.Queue" = queue.Queue()
         with self._lock:
@@ -119,6 +241,7 @@ class LLMServer:
                 max_tokens=max_tokens,
                 temperature=temperature,
                 eos_id=eos_id,
+                adapter_id=adapter_id or "",
             )
         )
         try:
@@ -134,9 +257,11 @@ class LLMServer:
                 self._token_queues.pop(rid, None)
 
     def generate(self, prompt_ids, max_tokens=64, temperature=0.0,
-                 eos_id=None) -> List[int]:
+                 eos_id=None, adapter_id=None) -> List[int]:
         return list(
-            self.generate_stream(prompt_ids, max_tokens, temperature, eos_id)
+            self.generate_stream(
+                prompt_ids, max_tokens, temperature, eos_id, adapter_id
+            )
         )
 
     def __call__(self, request: Dict[str, Any]):
@@ -149,11 +274,18 @@ class LLMServer:
         prompt_ids = request.get("prompt_ids")
         if prompt_ids is None:
             raise ValueError("request must contain 'prompt_ids'")
+        # "model" in the body (openai-style) beats routing context; the
+        # base model's own name routes to the base engine, anything else
+        # is a LoRA adapter id (reference ray.llm routing semantics)
+        model = request.get("model")
+        if model is not None and model in ("", self.config.model_id):
+            model = ""
         toks = self.generate(
             prompt_ids,
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             eos_id=request.get("eos_id"),
+            adapter_id=model,
         )
         return {"token_ids": toks, "num_generated": len(toks)}
 

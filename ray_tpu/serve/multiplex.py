@@ -44,6 +44,32 @@ def registered_model_ids() -> List[str]:
     return ids
 
 
+class _FnReporter:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def model_ids(self) -> List[str]:
+        return list(self._fn())
+
+
+def register_model_reporter(fn) -> Any:
+    """Public hook for components with their own model caches (e.g. the
+    LLM server's LoRA engines): ``fn() -> list[str]`` of loaded ids.
+    Returns a handle for unregister_model_reporter."""
+    reporter = _FnReporter(fn)
+    with _registry_lock:
+        _wrappers.append(reporter)
+    return reporter
+
+
+def unregister_model_reporter(handle) -> None:
+    with _registry_lock:
+        try:
+            _wrappers.remove(handle)
+        except ValueError:
+            pass
+
+
 class _ModelMultiplexWrapper:
     """Per-replica LRU of loaded models keyed by model id."""
 
