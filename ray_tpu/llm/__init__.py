@@ -9,16 +9,18 @@ from ._internal.engine import GenRequest, LlamaEngine
 from .batch import build_llm_processor
 from .config import LLMConfig, save_params_npz
 from .lora import apply_lora, load_lora_adapter
-from .serve import LLMServer, build_llm_app
+from .serve import LLMServer, OpenAIServer, build_llm_app, build_openai_app
 
 __all__ = [
     "GenRequest",
     "LLMConfig",
     "LLMServer",
     "LlamaEngine",
+    "OpenAIServer",
     "apply_lora",
     "build_llm_app",
     "build_llm_processor",
+    "build_openai_app",
     "load_lora_adapter",
     "save_params_npz",
 ]

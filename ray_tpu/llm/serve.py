@@ -73,8 +73,11 @@ class LLMServer:
             return [aid for aid in self._engines if aid]
 
     def shutdown(self) -> None:
-        """Stop the batching loop and drop the multiplex registration
-        (a torn-down replica must not pin engines or report stale ids)."""
+        """Stop the batching loop and drop the multiplex registration.
+        Must be called explicitly for in-process servers: the batching
+        thread and the multiplex registry hold strong refs, so __del__
+        would never fire (Serve replicas die with their actor process,
+        which achieves the same)."""
         self._running = False
         if self._reporter is not None:
             from ray_tpu.serve.multiplex import unregister_model_reporter
@@ -82,15 +85,15 @@ class LLMServer:
             unregister_model_reporter(self._reporter)
             self._reporter = None
 
-    def __del__(self):
-        try:
-            self.shutdown()
-        except Exception:
-            pass
-
     def _engine_for(self, adapter_id: str):
         """Engine serving this adapter, loading + folding on first use
-        (LRU-capped per lora_config.max_adapters_per_replica)."""
+        (LRU-capped per lora_config.max_adapters_per_replica).
+
+        Callers invoke this at SUBMISSION time (their own thread) so a
+        cold load — disk read + fold + KV-cache alloc + first XLA
+        compile — never stalls the batching loop's token emission for
+        other requests; the loop only re-resolves on the rare
+        submitted-then-evicted race."""
         with self._engines_lock:
             eng = self._engines.get(adapter_id)
             if eng is not None:
@@ -113,6 +116,21 @@ class LLMServer:
             # escape dynamic_lora_loading_path
             raise ValueError(f"invalid adapter id {adapter_id!r}")
 
+        cap = int(lora.get("max_adapters_per_replica", 4))
+        with self._engines_lock:
+            # HARD cap: when every loaded adapter is mid-generation and
+            # the cap is reached, refuse — an unbounded engine pile-up
+            # (full KV cache each) OOMs the replica
+            busy = [
+                aid for aid in self._engines
+                if aid and self._engines[aid].num_active()
+            ]
+            if len(busy) >= cap:
+                raise RuntimeError(
+                    f"all {cap} adapter slots are busy; retry later "
+                    "(max_adapters_per_replica)"
+                )
+
         from ._internal.engine import LlamaEngine
         from .lora import apply_lora, load_lora_adapter
 
@@ -134,8 +152,10 @@ class LLMServer:
             max_seq=self.config.max_seq_len,
             **self.config.engine_kwargs,
         )
-        cap = int(lora.get("max_adapters_per_replica", 4))
         with self._engines_lock:
+            existing = self._engines.get(adapter_id)
+            if existing is not None:  # lost a racing load of the same id
+                return existing
             self._engines[adapter_id] = eng
             # LRU-evict idle adapters past the cap — never the base "",
             # never an engine mid-generation, never the one just loaded
@@ -230,6 +250,10 @@ class LLMServer:
             from ray_tpu.serve import get_multiplexed_model_id
 
             adapter_id = get_multiplexed_model_id()
+        if adapter_id:
+            # cold-load in THIS thread (see _engine_for docstring): load
+            # errors also surface here, at submission, with a stack
+            self._engine_for(adapter_id)
         rid = f"req{next(self._id_counter)}"
         q: "queue.Queue" = queue.Queue()
         with self._lock:
@@ -274,12 +298,8 @@ class LLMServer:
         prompt_ids = request.get("prompt_ids")
         if prompt_ids is None:
             raise ValueError("request must contain 'prompt_ids'")
-        # "model" in the body (openai-style) beats routing context; the
-        # base model's own name routes to the base engine, anything else
-        # is a LoRA adapter id (reference ray.llm routing semantics)
-        model = request.get("model")
-        if model is not None and model in ("", self.config.model_id):
-            model = ""
+        # "model" in the body (openai-style) beats routing context
+        model = self._resolve_adapter(request)
         toks = self.generate(
             prompt_ids,
             max_tokens=int(request.get("max_tokens", 64)),
@@ -289,6 +309,16 @@ class LLMServer:
         )
         return {"token_ids": toks, "num_generated": len(toks)}
 
+    def _resolve_adapter(self, request: Dict[str, Any]) -> Optional[str]:
+        """'model' in a request body -> adapter id: the base model's own
+        name (model_id) or "" routes to the base engine; anything else
+        is a LoRA adapter id (reference ray.llm routing semantics).
+        None = no field, fall back to the serve routing context."""
+        model = request.get("model")
+        if model is not None and model in ("", self.config.model_id):
+            return ""
+        return model
+
     def engine_stats(self) -> Dict[str, Any]:
         return {
             "active": self.engine.num_active(),
@@ -297,7 +327,7 @@ class LLMServer:
         }
 
 
-def build_llm_app(llm_config: LLMConfig, name: str = "llm"):
+def build_llm_app(llm_config: LLMConfig, name: str = "llm", server_cls=None):
     """Bound deployment for `serve.run` (reference: build_openai_app).
     Sizes actor resources from the TP x PP placement bundles."""
     from ray_tpu import serve
@@ -309,7 +339,7 @@ def build_llm_app(llm_config: LLMConfig, name: str = "llm"):
     # cross-host pp stages)
     num_tpus = bundles[0].get("TPU", 0) if llm_config.accelerator_type == "TPU" else 0
     deployment = serve.deployment(
-        _LLMServerWrapper,
+        server_cls or _LLMServerWrapper,
         name=name,
         ray_actor_options={"num_tpus": num_tpus} if num_tpus else None,
     )
@@ -319,3 +349,65 @@ def build_llm_app(llm_config: LLMConfig, name: str = "llm"):
 class _LLMServerWrapper(LLMServer):
     """Deployment wrapper (serve.deployment needs a fresh class so user
     code can also subclass LLMServer directly)."""
+
+
+class OpenAIServer(LLMServer):
+    """OpenAI-style completions surface (reference: build_openai_app's
+    router deployments). Accepts completion bodies:
+
+        {"model": "<model_id or lora adapter id>",
+         "prompt": [token ids] (or "prompt_ids"),
+         "max_tokens": N, "temperature": t}
+
+    and answers {"object": "text_completion", "model": ...,
+    "choices": [{"token_ids": [...], "index": 0,
+    "finish_reason": "length"|"stop"}], "usage": {...}}. Token-id in/out:
+    tokenization happens client-side (there is no tokenizer dependency
+    in-tree)."""
+
+    def __call__(self, request: Dict[str, Any]):
+        import json
+
+        if "prompt" not in request and "prompt_ids" not in request and request.get("body"):
+            request = json.loads(request["body"])
+        prompt_ids = request.get("prompt_ids") or request.get("prompt")
+        if not isinstance(prompt_ids, list):
+            raise ValueError(
+                "completion request needs 'prompt' (token-id list)"
+            )
+        adapter = self._resolve_adapter(request)
+        max_tokens = int(request.get("max_tokens", 64))
+        eos_id = request.get("eos_id")
+        toks = self.generate(
+            prompt_ids,
+            max_tokens=max_tokens,
+            temperature=float(request.get("temperature", 0.0)),
+            eos_id=eos_id,
+            adapter_id=adapter,
+        )
+        # "stop" ONLY on an eos match; anything else — max_tokens hit or
+        # the engine's max_seq context truncation — is "length"
+        finish = (
+            "stop"
+            if eos_id is not None and toks and toks[-1] == eos_id
+            else "length"
+        )
+        return {
+            "object": "text_completion",
+            "model": adapter or self.config.model_id,
+            "choices": [
+                {"index": 0, "token_ids": toks, "finish_reason": finish}
+            ],
+            "usage": {
+                "prompt_tokens": len(prompt_ids),
+                "completion_tokens": len(toks),
+                "total_tokens": len(prompt_ids) + len(toks),
+            },
+        }
+
+
+def build_openai_app(llm_config: LLMConfig, name: str = "v1-completions"):
+    """Bound OpenAI-compatible completions app (reference:
+    ray.llm build_openai_app); serve with
+    ``serve.run(app, route_prefix="/v1/completions")``."""
+    return build_llm_app(llm_config, name=name, server_cls=OpenAIServer)

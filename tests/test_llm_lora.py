@@ -130,3 +130,32 @@ def test_adapter_lru_eviction(lora_dir):
     # evicted adapter reloads transparently and reproduces its output
     assert server.generate(PROMPT, max_tokens=2, adapter_id="ad_a") == out["ad_a"]
     server.shutdown()
+
+
+def test_openai_completions_surface(lora_dir):
+    """OpenAI-style completion bodies against the base model and a LoRA
+    adapter (reference: build_openai_app router)."""
+    from ray_tpu.llm import LLMConfig, OpenAIServer
+
+    server = OpenAIServer(LLMConfig(
+        model_config=CFG,
+        model_id="tiny-llama",
+        max_batch_size=4,
+        max_seq_len=64,
+        lora_config={"dynamic_lora_loading_path": lora_dir},
+    ))
+    try:
+        out = server({"model": "tiny-llama", "prompt": PROMPT, "max_tokens": 3})
+        assert out["object"] == "text_completion"
+        assert out["usage"] == {
+            "prompt_tokens": 3, "completion_tokens": 3, "total_tokens": 6,
+        }
+        base_toks = out["choices"][0]["token_ids"]
+        assert len(base_toks) == 3
+        out_a = server({"model": "ad_a", "prompt": PROMPT, "max_tokens": 3})
+        assert out_a["choices"][0]["token_ids"] == _expected_tokens(
+            f"{lora_dir}/ad_a.npz"
+        )
+        assert out_a["model"] == "ad_a"
+    finally:
+        server.shutdown()
