@@ -9,6 +9,7 @@ from .algorithm import Algorithm
 from .appo import APPO, APPOConfig
 from .bc import BC, BCConfig
 from .core import MLPSpec, forward, init_mlp_module, sample_actions
+from .cql import CQL, CQLConfig
 from .env_runner import SingleAgentEnvRunner
 from .dqn import DQN, DQNConfig
 from .impala import IMPALA, IMPALAConfig, vtrace
@@ -23,6 +24,8 @@ __all__ = [
     "APPOConfig",
     "BC",
     "BCConfig",
+    "CQL",
+    "CQLConfig",
     "DQN",
     "DQNConfig",
     "IMPALA",

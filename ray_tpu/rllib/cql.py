@@ -1,0 +1,149 @@
+"""CQL: conservative Q-learning for offline RL (discrete actions).
+
+Parity: python/ray/rllib/algorithms/cql/ — offline TD learning with the
+conservative regularizer alpha * E[logsumexp_a Q(s,a) - Q(s, a_data)],
+which pushes down Q on out-of-distribution actions so the greedy policy
+stays inside the dataset's support. Data flows the rllib/offline way:
+a Dataset of (obs, actions, rewards, next_obs, dones) transitions is
+staged into the replay buffer and minibatched into one jitted update
+(double-Q target + CQL penalty + Adam).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dqn import _init_q_net, _q_values, double_q_target
+from .replay_buffers import ReplayBuffer
+
+
+@dataclass
+class CQLConfig:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    cql_alpha: float = 1.0  # conservative penalty weight (min_q_weight)
+    grad_clip: float = 10.0
+    target_network_update_freq: int = 200
+    train_batch_size: int = 256
+    buffer_capacity: int = 1_000_000
+    hiddens: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def training(self, **kwargs) -> "CQLConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown CQL training param {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def build_algo(self, obs_dim: int, num_actions: int) -> "CQL":
+        return CQL(self, obs_dim, num_actions)
+
+
+class CQL:
+    def __init__(self, config: CQLConfig, obs_dim: int, num_actions: int):
+        import optax
+
+        from .core import MLPSpec
+
+        self.config = config
+        self.spec = MLPSpec(obs_dim, num_actions, tuple(config.hiddens))
+        self.params = _init_q_net(jax.random.PRNGKey(config.seed), self.spec)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr),
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        gamma = config.gamma
+        alpha = config.cql_alpha
+
+        def loss_fn(params, target_params, batch):
+            q = _q_values(params, batch["obs"])  # (B, A)
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1
+            )[:, 0]
+            target = double_q_target(
+                params, target_params, batch, gamma=gamma, double_q=True
+            )
+            td = q_taken - target
+            td_loss = jnp.mean(optax.huber_loss(td))
+            # conservative penalty: push down the soft-max over ALL
+            # actions, push up the dataset action
+            cql_penalty = jnp.mean(
+                jax.scipy.special.logsumexp(q, axis=1) - q_taken
+            )
+            return td_loss + alpha * cql_penalty, (td_loss, cql_penalty)
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            (loss, (td, pen)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params, target_params, batch)
+            updates, opt_state = self.optimizer.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td, pen
+
+        self._update = update
+        self.buffer = ReplayBuffer(config.buffer_capacity, seed=config.seed)
+        self.iteration = 0
+        self._updates = 0
+
+    def stage_dataset(self, dataset) -> int:
+        """Load an offline transitions Dataset into the replay buffer.
+        Fails loudly on overflow — silently ring-dropping offline rows
+        would invalidate training without a trace."""
+        n = 0
+        for batch in dataset.iter_batches(batch_size=4096, batch_format="numpy"):
+            staged = {
+                "obs": np.asarray(batch["obs"], np.float32).reshape(
+                    len(batch["actions"]), -1
+                ),
+                "actions": np.asarray(batch["actions"], np.int64),
+                "rewards": np.asarray(batch["rewards"], np.float32),
+                "next_obs": np.asarray(batch["next_obs"], np.float32).reshape(
+                    len(batch["actions"]), -1
+                ),
+                "dones": np.asarray(batch["dones"], np.float32),
+            }
+            self.buffer.add(staged)
+            n += len(staged["actions"])
+            if n > self.config.buffer_capacity:
+                raise ValueError(
+                    f"offline dataset exceeds buffer_capacity="
+                    f"{self.config.buffer_capacity}; raise it in CQLConfig"
+                )
+        return n
+
+    def train(self, num_updates: int = 256) -> Dict[str, Any]:
+        if num_updates <= 0:
+            raise ValueError(f"num_updates must be positive, got {num_updates}")
+        if not len(self.buffer):
+            raise RuntimeError("stage_dataset() before train()")
+        c = self.config
+        loss = td = pen = float("nan")
+        for _ in range(num_updates):
+            batch = self.buffer.sample(c.train_batch_size)
+            self.params, self.opt_state, loss, td, pen = self._update(
+                self.params, self.target_params, self.opt_state, batch
+            )
+            self._updates += 1
+            if self._updates % c.target_network_update_freq == 0:
+                self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "num_updates_lifetime": self._updates,
+            "loss": float(loss),
+            "td_loss": float(td),
+            "cql_penalty": float(pen),
+        }
+
+    def compute_single_action(self, obs) -> int:
+        q = _q_values(self.params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(q[0]))

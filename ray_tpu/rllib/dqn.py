@@ -99,6 +99,23 @@ def _q_values(params, obs):
     return x @ params["head"]["w"] + params["head"]["b"]
 
 
+def double_q_target(params, target_params, batch, *, gamma: float,
+                    double_q: bool = True):
+    """Bellman target shared by DQN and CQL: online net selects, target
+    net evaluates (or plain max), stop-gradient applied."""
+    q_next_target = _q_values(target_params, batch["next_obs"])
+    if double_q:
+        next_a = jnp.argmax(_q_values(params, batch["next_obs"]), axis=1)
+        q_next = jnp.take_along_axis(
+            q_next_target, next_a[:, None], axis=1
+        )[:, 0]
+    else:
+        q_next = jnp.max(q_next_target, axis=1)
+    return batch["rewards"] + gamma * (1.0 - batch["dones"]) * (
+        jax.lax.stop_gradient(q_next)
+    )
+
+
 _UPDATE_CACHE: dict = {}
 
 
@@ -116,17 +133,9 @@ def make_dqn_update(config: DQNConfig, spec: MLPSpec):
     def loss_fn(params, target_params, batch):
         q = _q_values(params, batch["obs"])
         q_taken = jnp.take_along_axis(q, batch["actions"][:, None], axis=1)[:, 0]
-        q_next_target = _q_values(target_params, batch["next_obs"])
-        if config.double_q:
-            # double DQN: online net selects, target net evaluates
-            next_a = jnp.argmax(_q_values(params, batch["next_obs"]), axis=1)
-            q_next = jnp.take_along_axis(
-                q_next_target, next_a[:, None], axis=1
-            )[:, 0]
-        else:
-            q_next = jnp.max(q_next_target, axis=1)
-        target = batch["rewards"] + config.gamma * (1.0 - batch["dones"]) * (
-            jax.lax.stop_gradient(q_next)
+        target = double_q_target(
+            params, target_params, batch,
+            gamma=config.gamma, double_q=config.double_q,
         )
         td = q_taken - target
         return jnp.mean(optax.huber_loss(td)), td
