@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 import time
 from functools import partial
@@ -81,6 +82,14 @@ def main() -> None:
         cfg = dataclasses.replace(
             llama.LLAMA_BENCH, param_dtype=jnp.bfloat16, remat=True,
             attention_impl="flash",  # Pallas kernel on TPU (ops/pallas_attention)
+            # fused lm-head CE kernel (ops/pallas_ce): interpret-mode
+            # validated; flip on after one live-chip check
+            ce_impl=(
+                "fused"
+                if os.environ.get("RAY_TPU_BENCH_FUSED_CE", "").lower()
+                in ("1", "true", "yes")
+                else "xla"
+            ),
         )
         batch, seq, steps = 8, 2048, 10
 

@@ -51,6 +51,8 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     attention_impl: str = "xla"  # "xla" | "flash" (pallas/blockwise)
+    ce_impl: str = "xla"  # "xla" | "fused" (pallas lm-head CE; needs
+    # B*S % 128 == 0, vocab % 128 == 0, no logit softcap)
     # logits softcap (Gemma-style) kept for generality; 0 disables.
     logit_softcap: float = 0.0
 
@@ -301,13 +303,10 @@ def block_fn(config: LlamaConfig, x: jax.Array, layer: Dict[str, jax.Array],
     return x
 
 
-def forward(params: Dict[str, Any], tokens: jax.Array,
-            config: LlamaConfig) -> jax.Array:
-    """tokens (B, S) int32 → logits (B, S, V) float32.
-
-    Layers run under lax.scan over the stacked-params leading axis;
-    each iteration optionally rematerialized.
-    """
+def forward_hidden(params: Dict[str, Any], tokens: jax.Array,
+                   config: LlamaConfig) -> jax.Array:
+    """tokens (B, S) int32 → final-norm hidden states (B, S, D) in
+    config.dtype (everything except the lm-head projection)."""
     c = config
     B, S = tokens.shape
     x = params["embed"].astype(c.dtype)[tokens]
@@ -323,7 +322,18 @@ def forward(params: Dict[str, Any], tokens: jax.Array,
         return blk(carry, layer, cos, sin), None
 
     x, _ = jax.lax.scan(scan_body, x, params["blocks"])
-    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    return rms_norm(x, params["final_norm"], c.norm_eps)
+
+
+def forward(params: Dict[str, Any], tokens: jax.Array,
+            config: LlamaConfig) -> jax.Array:
+    """tokens (B, S) int32 → logits (B, S, V) float32.
+
+    Layers run under lax.scan over the stacked-params leading axis;
+    each iteration optionally rematerialized.
+    """
+    c = config
+    x = forward_hidden(params, tokens, c)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(c.dtype))
     logits = logits.astype(jnp.float32)
     if c.logit_softcap:
@@ -344,20 +354,55 @@ def unpack_batch(batch: Dict[str, jax.Array]):
     return batch["inputs"], batch["targets"], batch.get("mask")
 
 
-def masked_ce(logits: jax.Array, targets: jax.Array, mask) -> jax.Array:
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+def masked_mean(nll: jax.Array, mask) -> jax.Array:
+    """Masked-mean reduction shared by every CE path."""
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)
 
 
+def masked_ce(logits: jax.Array, targets: jax.Array, mask) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return masked_mean(nll, mask)
+
+
 def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
             config: LlamaConfig) -> jax.Array:
     """Next-token cross entropy. batch: {"tokens": (B, S+1) int32} or
-    {"inputs": (B,S), "targets": (B,S)} with optional "mask"."""
+    {"inputs": (B,S), "targets": (B,S)} with optional "mask".
+
+    ce_impl="fused" routes the lm-head projection + softmax-CE through
+    the Pallas kernel (ops/pallas_ce.py): fp32 logits never touch HBM.
+    """
+    c = config
     inputs, targets, mask = unpack_batch(batch)
-    logits = forward(params, inputs, config)
+    B, S = inputs.shape
+    if c.ce_impl == "fused":
+        # an explicit "fused" request that can't be honored must FAIL,
+        # not silently run XLA — a fused-kernel benchmark or live-chip
+        # validation would otherwise measure the wrong implementation
+        problems = []
+        if c.logit_softcap:
+            problems.append("logit_softcap is set")
+        if (B * S) % 128 != 0:
+            problems.append(f"B*S={B * S} not a multiple of 128")
+        if c.vocab_size % 128 != 0:
+            problems.append(f"vocab_size={c.vocab_size} not a multiple of 128")
+        if problems:
+            raise ValueError(
+                "ce_impl='fused' not applicable: " + "; ".join(problems)
+            )
+        from ray_tpu.ops.pallas_ce import fused_cross_entropy
+
+        x = forward_hidden(params, inputs, c)
+        nll = fused_cross_entropy(
+            x.reshape(B * S, c.dim),
+            params["lm_head"].astype(c.dtype),
+            targets.reshape(B * S),
+        ).reshape(B, S)
+        return masked_mean(nll, mask)
+    logits = forward(params, inputs, c)
     return masked_ce(logits, targets, mask)
 
 
