@@ -107,8 +107,16 @@ class Dashboard:
                 content_type="text/plain",
             )
 
+        async def index(request):
+            import os
+
+            path = os.path.join(os.path.dirname(__file__), "index.html")
+            with open(path) as f:
+                return web.Response(text=f.read(), content_type="text/html")
+
         app = web.Application()
         # literal routes BEFORE the /api/{kind} catch-all
+        app.router.add_get("/", index)
         app.router.add_get("/api/cluster_status", cluster_status)
         app.router.add_get("/api/timeline", timeline)
         app.router.add_get("/api/jobs", jobs_list)

@@ -20,6 +20,7 @@ from .sample import (
 from .schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -37,6 +38,7 @@ __all__ = [
     "ConcurrencyLimiter",
     "FIFOScheduler",
     "OptunaSearch",
+    "PB2",
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",

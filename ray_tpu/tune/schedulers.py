@@ -192,3 +192,112 @@ class PopulationBasedTraining(TrialScheduler):
                 factor = self._rng.choice([0.8, 1.2])
                 out[k] = type(out[k])(out[k] * factor)
         return out
+
+
+class PB2(PopulationBasedTraining):
+    """Population-based bandits (reference: tune/schedulers/pb2.py).
+
+    PBT's exploit step with the random mutation replaced by a GP-UCB
+    bandit: reward IMPROVEMENTS are modeled as a Gaussian process over
+    (hyperparameters, time), and the explore step picks the
+    highest-UCB point inside ``hyperparam_bounds`` — sample-efficient
+    where PBT's multiplicative jitter is blind. Continuous bounds only
+    (the paper's setting); categorical params pass through unchanged.
+    GP backend: sklearn GaussianProcessRegressor (Matern 5/2).
+    """
+
+    def __init__(
+        self,
+        metric: Optional[str] = None,
+        mode: Optional[str] = None,
+        perturbation_interval: int = 5,
+        hyperparam_bounds: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        time_attr: str = "training_iteration",
+        ucb_kappa: float = 1.0,
+        seed: Optional[int] = None,
+    ):
+        super().__init__(
+            metric=metric,
+            mode=mode,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations={},
+            quantile_fraction=quantile_fraction,
+            time_attr=time_attr,
+            seed=seed,
+        )
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds={name: (lo, hi)}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        # observation history: per trial, last (t, config, score) to
+        # turn absolute scores into per-interval improvements
+        self._prev_obs: Dict[str, tuple] = {}
+        self._X: List[List[float]] = []   # [normalized hp..., t]
+        self._y: List[float] = []         # score improvement
+
+    def on_result(self, trial_id: str, metrics: Dict[str, Any]) -> str:
+        out = super().on_result(trial_id, metrics)
+        if self.metric and self.metric in metrics:
+            t = float(metrics.get(self.time_attr, 0))
+            score = self._sign * float(metrics[self.metric])
+            cfg = self._configs.get(trial_id, {})
+            prev = self._prev_obs.get(trial_id)
+            if prev is not None and all(k in cfg for k in self.bounds):
+                pt, pscore = prev
+                if t > pt:
+                    self._X.append(self._featurize(cfg, pt))
+                    self._y.append((score - pscore) / (t - pt))
+            self._prev_obs[trial_id] = (t, score)
+        return out
+
+    def _featurize(self, config: Dict[str, Any], t: float) -> List[float]:
+        feats = []
+        for k, (lo, hi) in self.bounds.items():
+            feats.append((float(config[k]) - lo) / max(hi - lo, 1e-12))
+        feats.append(t)  # raw; normalized against max-t at fit time so
+        # the isotropic kernel is not dominated by the time scale
+        return feats
+
+    def commit_exploit(self, trial_id: str, new_config: Dict[str, Any]) -> None:
+        super().commit_exploit(trial_id, new_config)
+        # the next report's score jump comes from the checkpoint CLONE,
+        # not from the new hyperparameters — recording it would teach
+        # the GP that whatever configs bottom trials clone into cause
+        # huge improvements
+        self._prev_obs.pop(trial_id, None)
+
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        if len(self._y) < 4:
+            # cold start: uniform sample inside the bounds
+            for k, (lo, hi) in self.bounds.items():
+                out[k] = lo + self._rng.random() * (hi - lo)
+            return out
+        import numpy as np
+        from sklearn.gaussian_process import GaussianProcessRegressor
+        from sklearn.gaussian_process.kernels import Matern
+
+        X = np.asarray(self._X, float)
+        t_max = max(X[:, -1].max(), 1.0)
+        X = X.copy()
+        X[:, -1] /= t_max  # time on the same [0,1] scale as the hps
+        y = np.asarray(self._y, float)
+        y = (y - y.mean()) / (y.std() + 1e-9)
+        gp = GaussianProcessRegressor(
+            kernel=Matern(nu=2.5), alpha=1e-4, normalize_y=False,
+            random_state=self._rng.randrange(2**31),
+        )
+        gp.fit(X, y)
+        rng = np.random.default_rng(self._rng.randrange(2**31))
+        n_cand = 256
+        cand = rng.random((n_cand, len(self.bounds)))
+        feats = np.concatenate(
+            [cand, np.ones((n_cand, 1))], axis=1  # t = now = max = 1.0
+        )
+        mean, std = gp.predict(feats, return_std=True)
+        best = int(np.argmax(mean + self.kappa * std))
+        for i, (k, (lo, hi)) in enumerate(self.bounds.items()):
+            out[k] = lo + float(cand[best, i]) * (hi - lo)
+        return out

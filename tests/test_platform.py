@@ -362,3 +362,20 @@ def test_pool_windowed_lazy_imap(ray_start_regular):
     ghost = AsyncResult([ObjectRef(ObjectID.generate())], single=False)
     with pytest.raises(ValueError):
         ghost.successful()
+
+
+def test_dashboard_serves_html_index(ray_start_regular):
+    """GET / returns the single-file UI over the JSON endpoints
+    (reference: the dashboard frontend, minus React)."""
+    import urllib.request
+
+    from ray_tpu import dashboard as dmod
+
+    d = dmod.Dashboard(port=18265).start()
+    try:
+        with urllib.request.urlopen("http://127.0.0.1:18265/", timeout=10) as r:
+            html = r.read().decode()
+        assert "ray_tpu dashboard" in html
+        assert "/api/cluster_status" in html
+    finally:
+        d.stop()
