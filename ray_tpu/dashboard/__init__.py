@@ -107,12 +107,14 @@ class Dashboard:
                 content_type="text/plain",
             )
 
-        async def index(request):
-            import os
+        import os
 
-            path = os.path.join(os.path.dirname(__file__), "index.html")
-            with open(path) as f:
-                return web.Response(text=f.read(), content_type="text/html")
+        with open(os.path.join(os.path.dirname(__file__), "index.html")) as f:
+            index_html = f.read()  # once: no per-request blocking read
+                                   # on the event-loop thread
+
+        async def index(request):
+            return web.Response(text=index_html, content_type="text/html")
 
         app = web.Application()
         # literal routes BEFORE the /api/{kind} catch-all

@@ -119,6 +119,35 @@ class Dataset:
         rows = list(ds.iter_rows())
         return {k: v for r in rows for k, v in r.items()}
 
+    # scalar aggregates (reference: Dataset.sum/min/max/mean/std —
+    # None on an empty dataset, matching the reference's contract)
+    def sum(self, on: str):
+        return self.aggregate(Sum(on)).get(f"sum({on})")
+
+    def min(self, on: str):
+        return self.aggregate(Min(on)).get(f"min({on})")
+
+    def max(self, on: str):
+        return self.aggregate(Max(on)).get(f"max({on})")
+
+    def mean(self, on: str):
+        return self.aggregate(Mean(on)).get(f"mean({on})")
+
+    def std(self, on: str, ddof: int = 1):
+        return self.aggregate(Std(on, ddof=ddof)).get(f"std({on})")
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (reference: Dataset.unique)."""
+        out = set()
+        for batch in self.select_columns([column]).iter_batches():
+            out.update(np.unique(batch[column]).tolist())
+        return sorted(out)
+
+    def show(self, limit: int = 20) -> None:
+        """Print the first rows (reference: Dataset.show)."""
+        for row in self.take(limit):
+            print(row)
+
     def union(self, *others: "Dataset") -> "Dataset":
         return self._append(L.Union(others=[o._logical.terminal for o in others]))
 

@@ -298,3 +298,20 @@ def test_read_images(ray_start_regular, tmp_path):
         assert r["image"].shape == (8, 8, 3)
         assert r["image"].dtype == np.uint8
         assert r["path"].endswith(".png")
+
+
+def test_scalar_aggregates_unique_show(ray_start_regular, capsys):
+    ds = rd.from_items([{"v": float(x)} for x in [3, 1, 4, 1, 5]])
+    assert ds.sum("v") == 14.0
+    assert ds.min("v") == 1.0
+    assert ds.max("v") == 5.0
+    assert abs(ds.mean("v") - 2.8) < 1e-9
+    assert ds.unique("v") == [1.0, 3.0, 4.0, 5.0]
+    ds.show(limit=2)
+    out = capsys.readouterr().out
+    assert "3.0" in out and out.count("\n") == 2
+
+
+def test_scalar_aggregates_empty_dataset(ray_start_regular):
+    ds = rd.from_items([])
+    assert ds.sum("v") is None and ds.mean("v") is None
