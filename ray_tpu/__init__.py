@@ -35,6 +35,7 @@ from ._private.worker import (
 from .actor import ActorClass, ActorHandle
 from .object_ref import ObjectRef, ObjectRefGenerator
 from .remote_function import RemoteFunction
+from .runtime_context import get_runtime_context
 
 __version__ = "0.1.0"
 
@@ -92,5 +93,6 @@ __all__ = [
     "ActorClass",
     "ActorHandle",
     "RemoteFunction",
+    "get_runtime_context",
     "exceptions",
 ]

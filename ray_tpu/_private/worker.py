@@ -28,6 +28,7 @@ _client: Optional[CoreClient] = None
 _hub: Optional[Hub] = None
 _session_dir: Optional[str] = None
 _is_worker = False
+_worker_runtime = None  # set by worker_process: get_runtime_context() actor ids
 
 
 def _set_global_client(client: CoreClient) -> None:

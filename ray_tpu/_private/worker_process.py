@@ -219,6 +219,12 @@ class WorkerRuntime:
             )
 
     def _run_actor_method(self, p: dict):
+        # pool threads don't inherit the main loop's contextvars: pin
+        # the task id here so get_runtime_context() works under
+        # max_concurrency > 1
+        from ..runtime_context import _current_task_id
+
+        _current_task_id.set(p.get("task_id"))
         method_name = p["method"]
         try:
             if method_name == "__ray_ready__":
@@ -421,9 +427,15 @@ def main():
     worker_mod._set_global_client(client)
 
     rt = WorkerRuntime(client)
+    worker_mod._worker_runtime = rt  # get_runtime_context() actor ids
+
+    from ..runtime_context import _current_task_id
+
     while True:
         try:
             msg_type, payload = client.task_queue.get()
+            if isinstance(payload, dict) and "task_id" in payload:
+                _current_task_id.set(payload["task_id"])
             if msg_type == P.KILL:
                 os._exit(0)
             elif msg_type in (P.EXEC_TASK, P.EXEC_ACTOR_TASK) and (

@@ -137,11 +137,17 @@ class Dataset:
         return self.aggregate(Std(on, ddof=ddof)).get(f"std({on})")
 
     def unique(self, column: str) -> List[Any]:
-        """Distinct values of one column (reference: Dataset.unique)."""
-        out = set()
+        """Distinct values of one column (reference: Dataset.unique —
+        no total order imposed; sorted only when the values allow it)."""
+        out: Dict[Any, None] = {}
         for batch in self.select_columns([column]).iter_batches():
-            out.update(np.unique(batch[column]).tolist())
-        return sorted(out)
+            for v in np.asarray(batch[column]).tolist():
+                out[v] = None
+        values = list(out)
+        try:
+            return sorted(values)
+        except TypeError:
+            return values  # mixed/unorderable types: first-seen order
 
     def show(self, limit: int = 20) -> None:
         """Print the first rows (reference: Dataset.show)."""

@@ -190,3 +190,34 @@ def test_cluster_resources(ray_start_regular):
 def test_nodes(ray_start_regular):
     ns = ray_tpu.nodes()
     assert len(ns) == 1 and ns[0]["alive"]
+
+
+def test_get_runtime_context(ray_start_regular):
+    """ray_tpu.get_runtime_context(): node/worker/task/actor identity
+    (reference: ray.runtime_context.RuntimeContext)."""
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.get_node_id()
+    assert ctx.get_worker_id() == "driver"
+    assert ctx.get_task_id() is None
+    assert ctx.get_actor_id() is None
+
+    @ray_tpu.remote
+    def who():
+        c = ray_tpu.get_runtime_context()
+        return c.get_task_id(), c.get_actor_id(), c.get_worker_id()
+
+    task_id, actor_id, worker_id = ray_tpu.get(who.remote())
+    assert task_id and actor_id is None
+    assert worker_id != "driver"
+
+    @ray_tpu.remote
+    class A:
+        def who(self):
+            c = ray_tpu.get_runtime_context()
+            return c.get_task_id(), c.get_actor_id()
+
+    a = A.remote()
+    t1, aid = ray_tpu.get(a.who.remote())
+    t2, aid2 = ray_tpu.get(a.who.remote())
+    assert aid and aid == aid2
+    assert t1 and t2 and t1 != t2
