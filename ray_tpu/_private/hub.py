@@ -1362,7 +1362,8 @@ class Hub:
                 "options": {
                     k: v for k, v in spec.options.items()
                     if k in ("max_concurrency", "streaming",
-                             "_generator_backpressure_num_objects")
+                             "_generator_backpressure_num_objects",
+                             "_restarted")
                 },
             },
         )
@@ -1882,6 +1883,10 @@ class Hub:
                         if s.options.get("streaming"):
                             self._end_stream_with_error(s.task_id, blob)
                     actor.inflight.clear()
+                    respawn_opts = dict(actor.options)
+                    # the new incarnation can tell it is a restart
+                    # (get_runtime_context().was_current_actor_reconstructed)
+                    respawn_opts["_restarted"] = True
                     respawn = TaskSpec(
                         task_id=actor.actor_id,
                         fn_id=actor.fn_id,
@@ -1889,7 +1894,7 @@ class Hub:
                         args_payload=actor.args_payload,
                         return_ids=[],
                         resources=actor.resources,
-                        options=dict(actor.options),
+                        options=respawn_opts,
                         is_actor_create=True,
                         actor_id=actor.actor_id,
                         ready_id=actor.ready_id,

@@ -201,6 +201,10 @@ class WorkerRuntime:
     def exec_actor_create(self, p: dict):
         if p.get("tpu_chips"):
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in p["tpu_chips"])
+        if (p.get("options") or {}).get("_restarted"):
+            # the hub marks respawned incarnations so user __init__ can
+            # branch on was_current_actor_reconstructed
+            os.environ["RAY_TPU_ACTOR_RESTARTED"] = "1"
         try:
             cls = self._get_fn(p["fn_id"], p.get("fn_blob"))
             args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])

@@ -26,6 +26,7 @@ from .dataset import (
     read_numpy,
     read_parquet,
     read_text,
+    read_webdataset,
 )
 from .datasource import Datasource, ReadTask
 from . import preprocessors
@@ -36,7 +37,7 @@ __all__ = [
     "GroupedData", "Max", "Mean", "Min", "ReadTask", "Std", "Sum",
     "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
     "read_binary_files", "read_csv", "read_datasource", "read_images",
-    "read_json", "read_numpy", "read_parquet", "read_text",
+    "read_json", "read_numpy", "read_parquet", "read_text", "read_webdataset",
 ]
 
 from ray_tpu._private import usage as _usage

@@ -141,7 +141,12 @@ class Dataset:
         no total order imposed; sorted only when the values allow it)."""
         out: Dict[Any, None] = {}
         for batch in self.select_columns([column]).iter_batches():
-            for v in np.asarray(batch[column]).tolist():
+            col = np.asarray(batch[column])
+            try:
+                vals = np.unique(col).tolist()  # C-speed for plain dtypes
+            except TypeError:
+                vals = col.tolist()  # mixed/unorderable object columns
+            for v in vals:
                 out[v] = None
         values = list(out)
         try:
@@ -597,6 +602,47 @@ def read_numpy(paths, *, parallelism: int = -1, **_kw) -> Dataset:
             return {"data": np.load(path)}
 
     return read_datasource(NpyDatasource(paths), parallelism=parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1, **_kw) -> Dataset:
+    """WebDataset tar shards -> one row per sample key; each extension
+    becomes a column of raw bytes, with .cls/.txt/.json decoded
+    (reference: data/datasource/webdataset_datasource.py; implemented
+    on stdlib tarfile — one read task per shard)."""
+    import json as _json
+    import tarfile
+
+    from .datasource import FileBasedDatasource
+
+    class WebDatasetDatasource(FileBasedDatasource):
+        def _read_file(self, path: str) -> Block:
+            samples: Dict[str, dict] = {}
+            order: List[str] = []
+            with tarfile.open(path) as tf:
+                for member in tf:
+                    if not member.isfile():
+                        continue
+                    # WebDataset convention: key = path up to the FIRST
+                    # dot of the BASENAME (dots in directories are part
+                    # of the key, not the extension)
+                    dirname, _, fname = member.name.rpartition("/")
+                    stem, _, ext = fname.partition(".")
+                    base = f"{dirname}/{stem}" if dirname else stem
+                    raw = tf.extractfile(member).read()
+                    if base not in samples:
+                        samples[base] = {"__key__": base}
+                        order.append(base)
+                    if ext in ("cls", "index"):
+                        samples[base][ext] = int(raw)
+                    elif ext in ("txt", "text"):
+                        samples[base][ext] = raw.decode()
+                    elif ext == "json":
+                        samples[base][ext] = _json.loads(raw)
+                    else:
+                        samples[base][ext] = raw
+            return [samples[k] for k in order]
+
+    return read_datasource(WebDatasetDatasource(paths), parallelism=parallelism)
 
 
 def read_images(

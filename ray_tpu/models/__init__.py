@@ -5,7 +5,7 @@ framework ships JAX-native models so its ML libraries have first-class
 workloads (flagship: Llama — BASELINE.json north star).
 """
 
-from . import llama, moe_llama
+from . import llama, moe_llama, vit
 from .llama import (
     LLAMA_2_7B,
     LLAMA_3_8B,
@@ -15,10 +15,16 @@ from .llama import (
     LlamaConfig,
 )
 from .moe_llama import MIXTRAL_8X7B, MOE_TINY, MoELlamaConfig
+from .vit import VIT_B_16, VIT_L_16, VIT_TINY, ViTConfig
 
 __all__ = [
     "llama",
     "moe_llama",
+    "vit",
+    "ViTConfig",
+    "VIT_B_16",
+    "VIT_L_16",
+    "VIT_TINY",
     "LlamaConfig",
     "LLAMA_2_7B",
     "LLAMA_3_8B",

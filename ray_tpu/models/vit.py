@@ -1,0 +1,225 @@
+"""ViT: vision transformer model family.
+
+Third first-class model family (dense Llama, MoE Llama, ViT) — the
+vision counterpart: patchify -> transformer encoder (pre-norm, GELU
+MLP, learned position embeddings, CLS token) -> classification head.
+TPU-first shape: patchify is one einsum-friendly reshape + projection
+(no conv kernels needed), the encoder runs as a stacked-layer
+lax.scan exactly like the Llama families, and param_specs shard
+attention heads / MLP over the `model` axis with `fsdp` on the
+embedding dims — the same mesh contract every trainer in this repo
+speaks.
+
+Reference parity: the reference ships no in-tree models (vision flows
+through torch downstream); in-tree families are what give Train/Serve/
+Data first-class workloads here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    channels: int = 3
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+
+VIT_B_16 = ViTConfig()
+VIT_L_16 = ViTConfig(dim=1024, n_layers=24, n_heads=16, mlp_dim=4096)
+VIT_TINY = ViTConfig(
+    image_size=32, patch_size=8, num_classes=10, dim=64, n_layers=2,
+    n_heads=4, mlp_dim=128, remat=False, dtype=jnp.float32,
+)
+
+
+def param_specs(config: ViTConfig) -> Dict[str, Any]:
+    """Mesh contract shared with the Llama families: heads/MLP on
+    `model`, embedding-like dims on `fsdp`."""
+    return {
+        "patch_proj": P(None, "fsdp"),            # (patch_dim, D)
+        "patch_bias": P(None),
+        "cls": P(None),                            # (D,)
+        "pos": P(None, "fsdp"),                    # (1+N, D)
+        "blocks": {
+            "ln1_scale": P(None, None),
+            "ln1_bias": P(None, None),
+            "wq": P(None, "fsdp", "model", None),  # (L, D, H, hd)
+            "wk": P(None, "fsdp", "model", None),
+            "wv": P(None, "fsdp", "model", None),
+            "wo": P(None, "model", None, "fsdp"),
+            "ln2_scale": P(None, None),
+            "ln2_bias": P(None, None),
+            "w1": P(None, "fsdp", "model"),        # (L, D, M)
+            "b1": P(None, "model"),
+            "w2": P(None, "model", "fsdp"),        # (L, M, D)
+            "b2": P(None, None),
+        },
+        "head_norm_scale": P(None),
+        "head_norm_bias": P(None),
+        "head": P("fsdp", None),                   # (D, classes)
+        "head_bias": P(None),
+    }
+
+
+def init_params(rng: jax.Array, config: ViTConfig) -> Dict[str, Any]:
+    c = config
+    hd = c.head_dim
+    L = c.n_layers
+    keys = jax.random.split(rng, 9)
+    (k_patch, k_cls, k_pos, k_q, k_k, k_v, k_o, k_mlp, k_head) = keys
+
+    def dense(key, shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            c.param_dtype
+        )
+
+    k1, k2 = jax.random.split(k_mlp)
+    return {
+        "patch_proj": dense(k_patch, (c.patch_dim, c.dim), c.patch_dim),
+        "patch_bias": jnp.zeros((c.dim,), c.param_dtype),
+        "cls": (jax.random.normal(k_cls, (c.dim,)) * 0.02).astype(c.param_dtype),
+        "pos": (
+            jax.random.normal(k_pos, (1 + c.n_patches, c.dim)) * 0.02
+        ).astype(c.param_dtype),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, c.dim), c.param_dtype),
+            "ln1_bias": jnp.zeros((L, c.dim), c.param_dtype),
+            "wq": dense(k_q, (L, c.dim, c.n_heads, hd), c.dim),
+            "wk": dense(k_k, (L, c.dim, c.n_heads, hd), c.dim),
+            "wv": dense(k_v, (L, c.dim, c.n_heads, hd), c.dim),
+            "wo": dense(k_o, (L, c.n_heads, hd, c.dim), c.dim),
+            "ln2_scale": jnp.ones((L, c.dim), c.param_dtype),
+            "ln2_bias": jnp.zeros((L, c.dim), c.param_dtype),
+            "w1": dense(k1, (L, c.dim, c.mlp_dim), c.dim),
+            "b1": jnp.zeros((L, c.mlp_dim), c.param_dtype),
+            "w2": dense(k2, (L, c.mlp_dim, c.dim), c.mlp_dim),
+            "b2": jnp.zeros((L, c.dim), c.param_dtype),
+        },
+        "head_norm_scale": jnp.ones((c.dim,), c.param_dtype),
+        "head_norm_bias": jnp.zeros((c.dim,), c.param_dtype),
+        "head": dense(k_head, (c.dim, c.num_classes), c.dim),
+        "head_bias": jnp.zeros((c.num_classes,), c.param_dtype),
+    }
+
+
+def param_count(config: ViTConfig) -> int:
+    params = init_params(jax.random.PRNGKey(0), config)
+    import numpy as np
+
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+
+
+def _layer_norm(x, scale, bias, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    return out * scale.astype(x.dtype) + bias.astype(x.dtype)
+
+
+def patchify(images: jax.Array, config: ViTConfig) -> jax.Array:
+    """(B, H, W, C) -> (B, N, patch_dim) without convolutions: a
+    reshape/transpose XLA fuses into the projection matmul."""
+    c = config
+    B, H, W, C = images.shape
+    p = c.patch_size
+    x = images.reshape(B, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, h, w, p, p, C)
+    return x.reshape(B, c.n_patches, c.patch_dim)
+
+
+def block_fn(config: ViTConfig, x: jax.Array, layer: Dict[str, jax.Array]):
+    c = config
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], c.norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(c.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(c.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(c.dtype))
+    logits = jnp.einsum("bqhk,bthk->bhqt", q, k) / math.sqrt(c.head_dim)
+    attn = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(c.dtype)
+    o = jnp.einsum("bhqt,bthk->bqhk", attn, v)
+    x = x + jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(c.dtype))
+
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], c.norm_eps)
+    h = jax.nn.gelu(
+        jnp.einsum("bsd,dm->bsm", h, layer["w1"].astype(c.dtype))
+        + layer["b1"].astype(c.dtype)
+    )
+    x = x + (
+        jnp.einsum("bsm,md->bsd", h, layer["w2"].astype(c.dtype))
+        + layer["b2"].astype(c.dtype)
+    )
+    return x
+
+
+def forward(params: Dict[str, Any], images: jax.Array,
+            config: ViTConfig) -> jax.Array:
+    """images (B, H, W, C) float -> class logits (B, num_classes) f32."""
+    c = config
+    B = images.shape[0]
+    patches = patchify(images.astype(c.dtype), c)
+    x = (
+        jnp.einsum("bnp,pd->bnd", patches, params["patch_proj"].astype(c.dtype))
+        + params["patch_bias"].astype(c.dtype)
+    )
+    cls = jnp.broadcast_to(params["cls"].astype(c.dtype), (B, 1, c.dim))
+    x = jnp.concatenate([cls, x], axis=1) + params["pos"].astype(c.dtype)
+
+    blk = partial(block_fn, c)
+    if c.remat:
+        blk = jax.checkpoint(blk, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(carry, layer):
+        return blk(carry, layer), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _layer_norm(
+        x[:, 0], params["head_norm_scale"], params["head_norm_bias"], c.norm_eps
+    )
+    logits = (
+        jnp.einsum("bd,dk->bk", x, params["head"].astype(c.dtype))
+        + params["head_bias"].astype(c.dtype)
+    )
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Dict[str, Any], batch: Dict[str, jax.Array],
+            config: ViTConfig) -> jax.Array:
+    """Softmax CE over classes; batch {"image": (B,H,W,C), "label": (B,)}."""
+    logits = forward(params, batch["image"], config)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(
+        jnp.take_along_axis(
+            logp, batch["label"][:, None].astype(jnp.int32), axis=1
+        )
+    )

@@ -196,3 +196,32 @@ def test_kill_pending_actor(ray_start_regular):
     ray_tpu.kill(a)
     with pytest.raises(ActorDiedError):
         ray_tpu.get(a.ping.remote(), timeout=20)
+
+
+def test_was_current_actor_reconstructed(ray_start_regular):
+    """Restarted incarnations see the flag (reference:
+    runtime_context.was_current_actor_reconstructed)."""
+    import os
+
+    @ray_tpu.remote(max_restarts=1)
+    class A:
+        def flag(self):
+            return ray_tpu.get_runtime_context().was_current_actor_reconstructed
+
+        def die(self):
+            os._exit(1)
+
+    a = A.remote()
+    assert ray_tpu.get(a.flag.remote()) is False
+    a.die.remote()
+    import time
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if ray_tpu.get(a.flag.remote(), timeout=10) is True:
+                break
+        except Exception:
+            time.sleep(0.2)
+    else:
+        raise AssertionError("restarted actor never reported the flag")
