@@ -182,6 +182,13 @@ class NodeAgent:
                     proc.kill()
                 except Exception:
                     pass
+        # the session dir holds RAM-backed object segments: leaking it
+        # across repeated join/terminate cycles eats /dev/shm (the
+        # Cluster harness also rmtree's from the parent side; harmless
+        # double-delete)
+        import shutil
+
+        shutil.rmtree(self.session_dir, ignore_errors=True)
         os._exit(0)
 
 

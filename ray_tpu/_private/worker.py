@@ -14,7 +14,6 @@ import os
 import shutil
 import tempfile
 import threading
-import uuid
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from .. import exceptions
@@ -130,10 +129,9 @@ def init(
         res["memory"] = float(kwargs.get("_memory", 64 * 1024**3))
         if resources:
             res.update(resources)
-        base = os.environ.get("RAY_TPU_TMPDIR") or (
-            "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
-        )
-        _session_dir = os.path.join(base, f"ray_tpu_{uuid.uuid4().hex[:12]}")
+        from .session import new_session_dir
+
+        _session_dir = new_session_dir()
         os.makedirs(_session_dir, exist_ok=True)
         _hub = Hub(
             _session_dir,

@@ -15,9 +15,7 @@ import json
 import os
 import subprocess
 import sys
-import tempfile
 import time
-import uuid
 from typing import Dict, List, Optional
 
 
@@ -63,12 +61,9 @@ class Cluster:
     ) -> ClusterNode:
         self._counter += 1
         node_id = f"node{self._counter}"
-        base = os.environ.get("RAY_TPU_TMPDIR") or (
-            "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
-        )
-        session_dir = os.path.join(
-            base, f"ray_tpu_{node_id}_{uuid.uuid4().hex[:8]}"
-        )
+        from ray_tpu._private.session import new_session_dir
+
+        session_dir = new_session_dir(f"ray_tpu_{node_id}")
         env = dict(os.environ)
         # the agent (and transitively its workers) must be able to import
         # ray_tpu and the driver's modules regardless of cwd

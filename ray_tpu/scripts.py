@@ -120,10 +120,19 @@ def cmd_start(args) -> None:
     addr = _resolve_address(args.address)
     if addr is None:
         raise SystemExit("start: need --head or --address tcp://host:port")
+    from ray_tpu._private.session import new_session_dir
+
+    node_id = args.node_id or f"cli-node-{os.getpid()}"
+    # a pre-set RAY_TPU_SESSION_DIR is honored (deployments may point
+    # cleanup/co-located tooling at a known path)
+    session_dir = os.environ.get("RAY_TPU_SESSION_DIR") or new_session_dir(
+        f"ray_tpu_{node_id}"
+    )
     env = dict(os.environ)
     env.update(
         RAY_TPU_HUB_ADDR=addr,
-        RAY_TPU_NODE_ID=args.node_id or f"cli-node-{os.getpid()}",
+        RAY_TPU_NODE_ID=node_id,
+        RAY_TPU_SESSION_DIR=session_dir,
         RAY_TPU_NUM_CPUS=str(args.num_cpus or (os.cpu_count() or 1)),
     )
     if args.num_tpus is not None:

@@ -93,3 +93,37 @@ def test_stop_halts_head(cli_cluster):
     _cli(env, "stop")
     head.wait(timeout=15)
     assert head.poll() is not None
+
+
+def test_node_joins_via_cli(cli_cluster):
+    """`python -m ray_tpu start --address tcp://...` turns this process
+    into a node agent that registers with the head (reference:
+    ray start --address)."""
+    env, addr, _head = cli_cluster
+    joiner = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", "start",
+         "--address", addr, "--num-cpus", "1", "--node-id", "clinode"],
+        env={**env, "RAY_TPU_NUM_TPUS": "0"},
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            out = _cli(env, "list", "nodes", "--format", "json")
+            nodes = json.loads(out)
+            if any(n["node_id"] == "clinode" and n["alive"] for n in nodes):
+                break
+            assert joiner.poll() is None, joiner.stdout.read()
+            time.sleep(0.3)
+        else:
+            raise AssertionError(f"cli node never registered: {nodes}")
+        out = _cli(env, "status")
+        assert "clinode" in out
+    finally:
+        joiner.terminate()
+        try:
+            joiner.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            joiner.kill()
