@@ -145,9 +145,8 @@ def main() -> None:
                 {
                     "note": (
                         "CPU FALLBACK - TPU tunnel unreachable; number "
-                        "not comparable to the TPU baseline. Last "
-                        "live-chip result: 18.5k tok/s, MFU 0.537, "
-                        "vs_baseline 1.07 (see BENCH_NOTE.md)"
+                        "not comparable to the TPU baseline. See "
+                        "BENCH_NOTE.md for the last live-chip result."
                     )
                 }
                 if on_cpu
