@@ -200,6 +200,9 @@ class WaitReq:
     num_returns: int
     deadline: Optional[float] = None
     done: bool = False
+    # incremental ready counter: arrivals bump this instead of re-scanning
+    # all ids (a 1k-ref wait used to cost O(n) per arrival = O(n^2) total)
+    n_ready: int = 0
 
 
 class Hub:
@@ -299,6 +302,11 @@ class Hub:
         self.get_reqs: List[GetReq] = []
         self.obj_get_waiters: Dict[bytes, List[GetReq]] = {}
         self.obj_wait_waiters: Dict[bytes, List[WaitReq]] = {}
+        # retransmit dedup: clients resend slow GET/WAIT requests every
+        # ~2s (lost-reply tolerance); while the original is still parked
+        # here, the resend must NOT register a second full waiter set.
+        # Keyed by (id(conn), req_id); purged on reply and on disconnect.
+        self._inflight_reqs: Dict[Tuple[int, int], Any] = {}
         self.dep_waiters: Dict[bytes, List[TaskSpec]] = {}
         self.timers: List[Tuple[float, int, Any]] = []  # (deadline, seq, callback)
         self._timer_seq = itertools.count()
@@ -560,11 +568,15 @@ class Hub:
             req.remaining.discard(oid)
             if not req.remaining:
                 self._fulfill_get(req)
-        # fulfill WAIT waiters
+        # fulfill WAIT waiters (registration is per-occurrence, so a req
+        # appearing k times in the list gets k increments — consistent
+        # with duplicate ids in the original request)
         for req in self.obj_wait_waiters.pop(oid, []):
             if req.done:
                 continue
-            self._check_wait(req)
+            req.n_ready += 1
+            if req.n_ready >= req.num_returns:
+                self._fulfill_wait(req)
         # ownership GC: the owner released this ref before the value
         # arrived — nothing can fetch it, free right away
         if self._released_early.pop(oid, None):
@@ -635,6 +647,7 @@ class Hub:
 
     def _fulfill_get(self, req: GetReq):
         req.done = True
+        self._inflight_reqs.pop((id(req.conn), req.req_id), None)
         values = []
         for oid in req.all_ids:
             e = self.objects[oid]
@@ -644,12 +657,16 @@ class Hub:
         self._reply(req.conn, req.req_id, values=values)
 
     def _on_get(self, conn, p):
+        key = (id(conn), p["req_id"])
+        if key in self._inflight_reqs:
+            return  # retransmit of a still-parked request; reply will come
         ids = p["object_ids"]
         missing = {oid for oid in ids if not self.objects.get(oid, ObjEntry()).ready}
         req = GetReq(conn=conn, req_id=p["req_id"], remaining=missing, all_ids=ids)
         if not missing:
             self._fulfill_get(req)
             return
+        self._inflight_reqs[key] = req
         for oid in missing:
             if oid not in self.objects:
                 self.objects[oid] = ObjEntry()
@@ -659,6 +676,7 @@ class Hub:
             def expire(req=req):
                 if not req.done:
                     req.done = True
+                    self._inflight_reqs.pop((id(req.conn), req.req_id), None)
                     self._unregister_get_waiter(req)
                     self._reply(req.conn, req.req_id, timeout=True)
             self._add_timer(timeout, expire)
@@ -688,32 +706,58 @@ class Hub:
                 if not lst:
                     del self.obj_wait_waiters[oid]
 
-    def _check_wait(self, req: WaitReq):
-        ready = [oid for oid in req.ids if self.objects.get(oid) and self.objects[oid].ready]
-        if len(ready) >= req.num_returns:
-            req.done = True
+    def _fulfill_wait(self, req: WaitReq, expired: bool = False):
+        """One final O(n) pass to build the reply; all intermediate
+        progress was tracked incrementally in req.n_ready."""
+        ready_all = []
+        for oid in req.ids:
+            e = self.objects.get(oid)
+            if e is not None and e.ready:
+                ready_all.append(oid)
+        if not expired and len(ready_all) < req.num_returns:
+            # a counted-ready object reverted (freed, or un-readied by
+            # node-loss reconstruction) after the initial scan; rebuild
+            # the incremental state and keep waiting (rare path)
             self._unregister_wait_waiter(req)
-            ready = ready[: req.num_returns]
-            rset = set(ready)
-            self._reply(
-                req.conn,
-                req.req_id,
-                ready=ready,
-                not_ready=[o for o in req.ids if o not in rset],
-            )
-            return True
-        return False
+            req.n_ready = len(ready_all)
+            for oid in req.ids:
+                if oid not in self.objects:
+                    self.objects[oid] = ObjEntry()
+                if not self.objects[oid].ready:
+                    self.obj_wait_waiters.setdefault(oid, []).append(req)
+            return
+        req.done = True
+        self._inflight_reqs.pop((id(req.conn), req.req_id), None)
+        self._unregister_wait_waiter(req)
+        ready = ready_all[: req.num_returns]
+        rset = set(ready)
+        self._reply(
+            req.conn,
+            req.req_id,
+            ready=ready,
+            not_ready=[o for o in req.ids if o not in rset],
+        )
 
     def _on_wait(self, conn, p):
+        key = (id(conn), p["req_id"])
+        if key in self._inflight_reqs:
+            return  # retransmit of a still-parked request; reply will come
+        ids = p["object_ids"]
         req = WaitReq(
             conn=conn,
             req_id=p["req_id"],
-            ids=p["object_ids"],
-            num_returns=min(p["num_returns"], len(p["object_ids"])),
+            ids=ids,
+            num_returns=min(p["num_returns"], len(ids)),
         )
-        if self._check_wait(req):
+        for oid in ids:
+            e = self.objects.get(oid)
+            if e is not None and e.ready:
+                req.n_ready += 1
+        if req.n_ready >= req.num_returns:
+            self._fulfill_wait(req)
             return
-        for oid in req.ids:
+        self._inflight_reqs[key] = req
+        for oid in ids:
             if oid not in self.objects:
                 self.objects[oid] = ObjEntry()
             if not self.objects[oid].ready:
@@ -722,14 +766,7 @@ class Hub:
         if timeout is not None:
             def expire(req=req):
                 if not req.done:
-                    req.done = True
-                    self._unregister_wait_waiter(req)
-                    ready = [o for o in req.ids if self.objects.get(o) and self.objects[o].ready]
-                    rset = set(ready)
-                    self._reply(
-                        req.conn, req.req_id,
-                        ready=ready, not_ready=[o for o in req.ids if o not in rset],
-                    )
+                    self._fulfill_wait(req, expired=True)
             self._add_timer(timeout, expire)
 
     def _on_release_owned(self, conn, p):
@@ -1201,15 +1238,21 @@ class Hub:
                     # warm-up spawning parallel, not one-per-pass). Each
                     # want carries ITS OWN spec's actor flag — the head's
                     # flag must not leak onto queued plain tasks (that
-                    # would bypass the pooled-worker cap).
+                    # would bypass the pooled-worker cap). Enumerate at
+                    # most max_workers wants: spawning can never exceed
+                    # the pool cap in one pass, and walking the WHOLE
+                    # queue here made every dispatch event O(queue) — a
+                    # 1k-task burst on a saturated pool went quadratic.
                     if self._last_spawn_node is not None and len(q) > 1:
+                        nd = self.nodes.get(self._last_spawn_node)
+                        cap = nd.max_workers if nd is not None else 32
                         self._spawn_wants.setdefault(
                             self._last_spawn_node, []
                         ).extend(
                             (s.options.get("runtime_env"),
                              s.options.get("runtime_env_hash", ""),
                              s.is_actor_create)
-                            for s in list(q)[1:]
+                            for s in itertools.islice(q, 1, 1 + cap)
                         )
                     break
             if not q:
@@ -1286,7 +1329,18 @@ class Hub:
                 # pin: chips leave the node's free pool for the worker's life
                 node.free_tpu_chips.difference_update(chips)
                 worker.pinned_chips = chips
+            was_warm = bool(worker.seen_fns)
             self._send_exec(worker, spec, chips)
+            if spec.is_actor_create and was_warm:
+                # the actor just pinned a WARM task worker for life (it
+                # has task history — a fresh spawn has none); restore the
+                # pool to its prior size so the next task burst doesn't
+                # pay cold worker-spawn latency (reference: the raylet
+                # prestarts replacement workers when actors take pool
+                # members, worker_pool.cc PrestartWorkers)
+                pooled = self._node_worker_count(node.node_id)
+                if pooled + node.spawning < node.max_workers:
+                    self._spawn_worker(node)
             return "placed"
         # Resources fit somewhere but no idle worker: request one where a
         # NEW worker could actually serve the task — for TPU tasks that
@@ -1790,6 +1844,9 @@ class Hub:
         for subs in self.subscribers.values():
             if conn in subs:
                 subs.remove(conn)
+        cid = id(conn)
+        for key in [k for k in self._inflight_reqs if k[0] == cid]:
+            del self._inflight_reqs[key]
         node_id = self.agent_conns.pop(conn, None)
         if node_id is not None:
             self._node_died(node_id)
