@@ -20,7 +20,6 @@ from __future__ import annotations
 import json
 import sys
 import time
-from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -94,6 +93,31 @@ def main() -> None:
         async def ping(self):
             return b"ok"
 
+    # A submitting client that lives in its own worker process — the
+    # reference's multi-client rows measure multi-PROCESS submission
+    # (ray_perf.py Client actor / work() tasks), not driver threads.
+    @ray_tpu.remote
+    class Client:
+        def __init__(self, targets=None):
+            self.targets = targets or []
+
+        def task_batch(self, n):
+            ray_tpu.get([nullary.remote() for _ in range(n)])
+            return n
+
+        def call_batch(self, n):
+            refs = []
+            for i in range(n):
+                refs.append(self.targets[i % len(self.targets)].ping.remote())
+            ray_tpu.get(refs)
+            return n
+
+        def arg_batch(self, n, arg_ref):
+            ray_tpu.get(
+                [t.sink.remote(arg_ref) for t in self.targets for _ in range(n)]
+            )
+            return n * len(self.targets)
+
     # warm the worker pool so spawn latency isn't measured
     ray_tpu.get([nullary.remote() for _ in range(16)])
 
@@ -113,10 +137,13 @@ def main() -> None:
 
     report("single_client_tasks_async", timeit(tasks_async), "tasks/s")
 
+    # 4 client processes each submitting a quarter of the tasks
+    # (reference shape: ray_perf.py "multi client tasks async")
+    task_clients = [Client.remote() for _ in range(4)]
+    ray_tpu.get([c.task_batch.remote(4) for c in task_clients])
+
     def tasks_multi():
-        with ThreadPoolExecutor(4) as pool:
-            list(pool.map(lambda _: ray_tpu.get(
-                [nullary.remote() for _ in range(N_ASYNC // 4)]), range(4)))
+        ray_tpu.get([c.task_batch.remote(N_ASYNC // 4) for c in task_clients])
         return N_ASYNC
 
     report("multi_client_tasks_async", timeit(tasks_multi), "tasks/s")
@@ -151,21 +178,26 @@ def main() -> None:
     actors = [Sink.remote() for _ in range(n_actors)]
     ray_tpu.get([x.ping.remote() for x in actors])
 
+    # one client process driving all n actors (reference shape:
+    # "1:n actor calls async" — Client.small_value_batch)
+    one_n_client = Client.remote(actors)
+    ray_tpu.get(one_n_client.call_batch.remote(n_actors))
+
     def one_n_async():
-        refs = []
-        for i in range(N_ASYNC):
-            refs.append(actors[i % n_actors].ping.remote())
-        ray_tpu.get(refs)
+        ray_tpu.get(one_n_client.call_batch.remote(N_ASYNC))
         return N_ASYNC
 
     report("1_n_actor_calls_async", timeit(one_n_async), "calls/s")
 
+    # m client processes each driving all n actors (reference shape:
+    # "n:n actor calls async" — m work() tasks over n_cpu actors)
+    nn_clients = [Client.remote(actors) for _ in range(n_actors)]
+    ray_tpu.get([c.call_batch.remote(n_actors) for c in nn_clients])
+
     def n_n_async():
-        with ThreadPoolExecutor(n_actors) as pool:
-            list(pool.map(
-                lambda x: ray_tpu.get(
-                    [x.ping.remote() for _ in range(N_ASYNC // n_actors)]),
-                actors))
+        ray_tpu.get(
+            [c.call_batch.remote(N_ASYNC // n_actors) for c in nn_clients]
+        )
         return N_ASYNC
 
     report("n_n_actor_calls_async", timeit(n_n_async), "calls/s")
@@ -174,12 +206,17 @@ def main() -> None:
     arg_ref = ray_tpu.put(arg)
     N_ARG = N_ASYNC // 10
 
+    # paired client->actor processes passing a shared 1 MiB object ref
+    # (reference shape: "n:n actor calls with arg async" — one Client
+    # per server actor, Client.small_value_batch_arg)
+    arg_clients = [Client.remote([a]) for a in actors]
+    ray_tpu.get([c.arg_batch.remote(1, arg_ref) for c in arg_clients])
+
     def n_n_with_arg():
-        with ThreadPoolExecutor(n_actors) as pool:
-            list(pool.map(
-                lambda x: ray_tpu.get(
-                    [x.sink.remote(arg_ref) for _ in range(N_ARG // n_actors)]),
-                actors))
+        ray_tpu.get(
+            [c.arg_batch.remote(N_ARG // n_actors, arg_ref)
+             for c in arg_clients]
+        )
         return N_ARG
 
     report("n_n_actor_calls_with_arg_async", timeit(n_n_with_arg), "calls/s")
@@ -233,10 +270,13 @@ def main() -> None:
     report("single_client_put_gigabytes", timeit(put_gb, warmup=0), "GiB/s")
 
     def wait_1k():
-        n = 2 if QUICK else 5
+        # reference shape (ray_perf.py wait_multiple_refs): pop one
+        # ready ref per wait() call until all 1000 are drained
+        n = 1 if QUICK else 3
         for _ in range(n):
-            refs = [nullary.remote() for _ in range(1000)]
-            ray_tpu.wait(refs, num_returns=1000, timeout=60)
+            not_ready = [nullary.remote() for _ in range(1000)]
+            while not_ready:
+                _ready, not_ready = ray_tpu.wait(not_ready, timeout=60)
         return n
 
     report("single_client_wait_1k_refs", timeit(wait_1k, warmup=0), "ops/s")

@@ -60,6 +60,9 @@ class CoreClient:
         self._pending_lock = threading.Lock()
         self._obj_cache: Dict[bytes, Any] = {}
         self._obj_cache_lock = threading.Lock()
+        # object ids known ready (from wait replies); insertion-ordered
+        # for FIFO bounding. Cleared per-id by free().
+        self._known_ready: Dict[bytes, bool] = {}
         self._seen_fns: Dict[str, Any] = {}
         self.task_queue: "queue.Queue" = queue.Queue()
         self.cancelled_tasks: set = set()  # task_ids to drop at dequeue
@@ -282,6 +285,8 @@ class CoreClient:
                 # (reference: object manager pull, ownership directory)
                 reply = self.request(P.FETCH_OBJECT, {"object_id": oid_bytes})
                 if reply.get("data") is None:
+                    with self._obj_cache_lock:
+                        self._known_ready.pop(oid_bytes, None)
                     raise exceptions.ObjectLostError(
                         f"object {oid_bytes.hex()} unavailable: "
                         f"{reply.get('error')}"
@@ -337,20 +342,42 @@ class CoreClient:
         timeout: Optional[float],
         fetch_local: bool = True,
     ) -> Tuple[List[bytes], List[bytes]]:
+        ids = [o.binary() for o in object_ids]
+        # Local fast path: readiness already known from a prior wait
+        # reply (also_ready) or a cached value — a wait() pop-loop over
+        # 1k refs then costs a handful of round trips instead of one per
+        # ref. Readiness is monotonic except for cross-client frees and
+        # node-loss reconstruction; in those rare races the follow-up
+        # get() blocks through reconstruction or raises ObjectLostError
+        # — the same TOCTOU a hub round-trip reply has (decode_value
+        # un-caches on loss, below).
+        known = self._known_ready
+        with self._obj_cache_lock:
+            ready_local = [
+                b for b in ids if b in known or b in self._obj_cache
+            ]
+        if len(ready_local) >= num_returns:
+            ready = ready_local[:num_returns]
+            rset = set(ready)
+            return ready, [b for b in ids if b not in rset]
         reply = self.request(
             P.WAIT,
-            {
-                "object_ids": [o.binary() for o in object_ids],
-                "num_returns": num_returns,
-                "timeout": timeout,
-            },
+            {"object_ids": ids, "num_returns": num_returns, "timeout": timeout},
         )
+        with self._obj_cache_lock:
+            for b in reply["ready"]:
+                known[b] = True
+            for b in reply.get("also_ready", ()):
+                known[b] = True
+            while len(known) > 65536:  # FIFO cap; eviction costs a re-ask
+                known.pop(next(iter(known)), None)
         return reply["ready"], reply["not_ready"]
 
     def free(self, object_ids: Sequence[ObjectID]) -> None:
         with self._obj_cache_lock:
             for o in object_ids:
                 self._obj_cache.pop(o.binary(), None)
+                self._known_ready.pop(o.binary(), None)
         for o in object_ids:
             # drop any locally-fetched copy of a remote segment too
             self.store.free(o.hex())

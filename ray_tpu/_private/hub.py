@@ -736,6 +736,11 @@ class Hub:
             req.req_id,
             ready=ready,
             not_ready=[o for o in req.ids if o not in rset],
+            # readiness beyond the quota: the client caches these so a
+            # wait() pop-loop drains locally instead of round-tripping
+            # per ref (the reference serves the same case from the core
+            # worker's local memory store)
+            also_ready=ready_all[req.num_returns:],
         )
 
     def _on_wait(self, conn, p):
@@ -1246,13 +1251,17 @@ class Hub:
                     if self._last_spawn_node is not None and len(q) > 1:
                         nd = self.nodes.get(self._last_spawn_node)
                         cap = nd.max_workers if nd is not None else 32
+                        # +64 headroom so actor gangs (uncapped by the
+                        # pool) larger than max_workers still spawn in
+                        # few waves; gangs beyond the bound progress
+                        # wave-by-wave as spawned workers connect
                         self._spawn_wants.setdefault(
                             self._last_spawn_node, []
                         ).extend(
                             (s.options.get("runtime_env"),
                              s.options.get("runtime_env_hash", ""),
                              s.is_actor_create)
-                            for s in itertools.islice(q, 1, 1 + cap)
+                            for s in itertools.islice(q, 1, 65 + cap)
                         )
                     break
             if not q:
@@ -1269,17 +1278,25 @@ class Hub:
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
                 continue
+            n_actor = sum(1 for _, _, ia in wants if ia)
+            # in-flight spawns satisfy actor wants first (actors are
+            # exempt from the pooled cap but must not re-spawn on every
+            # dispatch event while their workers are still booting)
+            actor_quota = max(0, n_actor - node.spawning)
             budget = max(
                 0,
                 min(
-                    len(wants) - node.spawning,
+                    (len(wants) - n_actor)
+                    - max(0, node.spawning - n_actor),
                     node.max_workers - self._node_worker_count(node_id),
                 ),
             )
             for renv, renv_hash, is_actor in wants:
                 if is_actor:
-                    self._spawn_worker(node, runtime_env=renv,
-                                       renv_hash=renv_hash)
+                    if actor_quota > 0:
+                        actor_quota -= 1
+                        self._spawn_worker(node, runtime_env=renv,
+                                           renv_hash=renv_hash)
                 elif budget > 0:
                     budget -= 1
                     self._spawn_worker(node, runtime_env=renv,
@@ -1340,7 +1357,13 @@ class Hub:
                 # members, worker_pool.cc PrestartWorkers)
                 pooled = self._node_worker_count(node.node_id)
                 if pooled + node.spawning < node.max_workers:
-                    self._spawn_worker(node)
+                    # replenish with the SAME runtime env the claimed
+                    # worker served, or env-specific bursts still stall
+                    self._spawn_worker(
+                        node,
+                        runtime_env=spec.options.get("runtime_env"),
+                        renv_hash=spec.options.get("runtime_env_hash", ""),
+                    )
             return "placed"
         # Resources fit somewhere but no idle worker: request one where a
         # NEW worker could actually serve the task — for TPU tasks that
