@@ -61,3 +61,59 @@ class TPUAcceleratorManager(AcceleratorManager):
     @staticmethod
     def set_current_process_visible_accelerators(ids: List[str]) -> None:
         os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in ids)
+
+
+def get_chip_topology(n_chips: int) -> Dict[int, tuple]:
+    """ICI topology of this host's chips: {chip_id: (x, y) or (x, y, z)}.
+
+    The SLICE placement strategy reserves ICI-contiguous chips; that
+    needs physical coordinates, which the reference never models (its
+    TPU support stops at per-pod gang resources, reference
+    python/ray/_private/accelerators/tpu.py:352-375).
+
+    Sources, in priority order:
+      - ``TPU_CHIP_COORDS``: explicit "id:x,y[,z];id:x,y[,z]" (tests,
+        exotic wiring),
+      - ``TPU_TOPOLOGY``: "XxY" or "XxYxZ" grid, chips numbered
+        row-major (the TPU VM metadata convention, e.g. v5e "2x4"),
+      - chip-count defaults for single-host slices (v5e hosts carry 1,
+        4, or 8 chips in 1x1 / 2x2 / 2x4 meshes).
+
+    Returns {} when the topology is unknown — SLICE is then rejected
+    rather than silently degraded.
+    """
+    spec = os.environ.get("TPU_CHIP_COORDS")
+    if spec:
+        try:
+            out: Dict[int, tuple] = {}
+            for part in spec.split(";"):
+                part = part.strip()
+                if not part:
+                    continue
+                cid, _, coord = part.partition(":")
+                out[int(cid)] = tuple(int(c) for c in coord.split(","))
+            return out
+        except ValueError:
+            return {}  # unknown topology; SLICE is rejected at creation
+    topo = os.environ.get("TPU_TOPOLOGY")
+    if not topo:
+        topo = {1: "1x1", 4: "2x2", 8: "2x4"}.get(n_chips)
+    if not topo:
+        return {}
+    try:
+        dims = [int(d) for d in topo.lower().split("x")]
+    except ValueError:
+        return {}
+    total = 1
+    for d in dims:
+        total *= d
+    if total != n_chips:
+        return {}
+    coords: Dict[int, tuple] = {}
+    for cid in range(n_chips):
+        rem, coord = cid, []
+        for d in reversed(dims):
+            coord.append(rem % d)
+            rem //= d
+        coords[cid] = tuple(reversed(coord))
+    return coords

@@ -92,6 +92,12 @@ class NodeEntry:
     total: Dict[str, float]
     avail: Dict[str, float]
     free_tpu_chips: Set[int] = field(default_factory=set)
+    # ICI topology: chip id -> mesh coordinate (empty = unknown); the
+    # SLICE strategy reserves coordinate-contiguous chips from it
+    chip_coords: Dict[int, tuple] = field(default_factory=dict)
+    # chips reserved by ready SLICE placement groups: out of the free
+    # pool, placeable only via their PG bundle
+    pg_reserved_chips: Set[int] = field(default_factory=set)
     max_workers: int = 4
     agent_conn: Any = None  # None => head node (hub-local spawning)
     alive: bool = True
@@ -166,6 +172,9 @@ class PGEntry:
     bundle_avail: List[Dict[str, float]] = field(default_factory=list)
     # node each bundle was reserved on (set when ready)
     bundle_nodes: List[str] = field(default_factory=list)
+    # SLICE only: the specific ICI-contiguous chip ids reserved per
+    # bundle; tasks scheduled into bundle i run on exactly these chips
+    bundle_chips: List[tuple] = field(default_factory=list)
 
 
 @dataclass
@@ -205,6 +214,52 @@ class WaitReq:
     n_ready: int = 0
 
 
+def _find_chip_path(coords: Dict[int, tuple], free: Set[int],
+                    length: int) -> Optional[List[int]]:
+    """A simple path of `length` chips through the free subset of the
+    ICI mesh (neighbors differ by 1 in exactly one coordinate — v5e 2D
+    meshes don't wrap below pod scale). Splitting such a path into
+    consecutive chunks yields per-bundle chip sets that are each
+    ICI-connected, which is what SLICE promises.
+
+    Bounded DFS with deterministic seed order (lexicographic coords) —
+    exact for the single-host sizes this runs on (<=8 chips per host on
+    v5e; a few hundred at most), bailing out after a fixed step budget
+    so a fragmented big mesh can't stall the hub reactor.
+    """
+    usable = [c for c in free if c in coords]
+    if length <= 0 or len(usable) < length:
+        return None
+    if length == 1:
+        return [min(usable, key=lambda c: coords[c])]
+    by_coord = {coords[c]: c for c in usable}
+
+    def neighbors(c: int):
+        base = coords[c]
+        for dim in range(len(base)):
+            for d in (-1, 1):
+                nb = list(base)
+                nb[dim] += d
+                n = by_coord.get(tuple(nb))
+                if n is not None:
+                    yield n
+
+    budget = 50_000
+    for seed in sorted(usable, key=lambda c: coords[c]):
+        stack = [(seed, (seed,))]
+        while stack and budget > 0:
+            budget -= 1
+            node, path = stack.pop()
+            if len(path) == length:
+                return list(path)
+            for n in neighbors(node):
+                if n not in path:
+                    stack.append((n, path + (n,)))
+        if budget <= 0:
+            break
+    return None
+
+
 class Hub:
     def __init__(
         self,
@@ -212,6 +267,7 @@ class Hub:
         resources: Dict[str, float],
         max_workers: Optional[int] = None,
         tpu_chip_ids: Optional[List[int]] = None,
+        tpu_chip_coords: Optional[Dict[int, tuple]] = None,
         worker_env: Optional[Dict[str, str]] = None,
         tcp: bool = False,
         host: str = "127.0.0.1",
@@ -258,6 +314,7 @@ class Hub:
             total=dict(resources),
             avail=dict(resources),
             free_tpu_chips=set(tpu_chip_ids or []),
+            chip_coords=dict(tpu_chip_coords or {}),
             max_workers=self.max_workers,
             agent_conn=None,
             store_cap=object_store_memory,
@@ -491,6 +548,10 @@ class Hub:
             total=dict(p["resources"]),
             avail=dict(p["resources"]),
             free_tpu_chips=set(p.get("tpu_chip_ids", [])),
+            chip_coords={
+                int(k): tuple(v)
+                for k, v in (p.get("tpu_chip_coords") or {}).items()
+            },
             max_workers=p.get("max_workers") or 4,
             agent_conn=conn,
             store_cap=float(p.get("store_cap") or 0),
@@ -1311,6 +1372,7 @@ class Hub:
             return "defer"
         kind, entry, bidx = pools[0]
         n_chips = int(spec.resources.get("TPU", 0))
+        chip_pool = None
         if kind == "pg":
             node = self.nodes.get(entry.bundle_nodes[bidx])
             if node is None or not node.alive:
@@ -1318,6 +1380,9 @@ class Hub:
             avail = entry.bundle_avail[bidx]
             if not self._resources_fit(spec.resources, avail):
                 return "defer"
+            if entry.bundle_chips:
+                # SLICE: the task runs on the bundle's reserved chips
+                chip_pool = entry.bundle_chips[bidx]
             candidates = [(node, avail)]
         else:
             allowed = self._candidate_nodes(spec)
@@ -1334,7 +1399,9 @@ class Hub:
             if not candidates:
                 return "defer"
         for node, avail in candidates:
-            worker, chips = self._find_idle_worker(spec, n_chips, node)
+            worker, chips = self._find_idle_worker(
+                spec, n_chips, node, chip_pool=chip_pool
+            )
             if worker is None:
                 continue
             self._acquire(spec.resources, avail)
@@ -1368,9 +1435,24 @@ class Hub:
         # Resources fit somewhere but no idle worker: request one where a
         # NEW worker could actually serve the task — for TPU tasks that
         # means the node still has n free chips (chips pinned to existing
-        # idle workers don't help a fresh process).
+        # idle workers don't help a fresh process). SLICE bundle tasks
+        # draw from the bundle's reserved chips, which live OUTSIDE the
+        # node free pool — count the unpinned ones instead.
         for node, _ in candidates:
-            if n_chips == 0 or len(node.free_tpu_chips) >= n_chips:
+            if chip_pool is not None:
+                live_pinned = {
+                    c
+                    for w in self.workers.values()
+                    if w.node_id == node.node_id and w.pinned_chips
+                    for c in w.pinned_chips
+                }
+                spawnable = (
+                    sum(1 for c in chip_pool if c not in live_pinned)
+                    >= n_chips
+                )
+            else:
+                spawnable = len(node.free_tpu_chips) >= n_chips
+            if n_chips == 0 or spawnable:
                 self._spawn_wants.setdefault(node.node_id, []).append(
                     (spec.options.get("runtime_env"),
                      spec.options.get("runtime_env_hash", ""),
@@ -1380,21 +1462,39 @@ class Hub:
                 break
         return "defer"
 
-    def _find_idle_worker(self, spec: TaskSpec, n_chips: int, node: NodeEntry):
+    def _find_idle_worker(self, spec: TaskSpec, n_chips: int,
+                          node: NodeEntry, chip_pool: Optional[tuple] = None):
         """Pick an idle worker ON THIS NODE; TPU tasks require chip
         affinity (a worker pinned to exactly n chips, or a fresh worker +
-        n free chips on the node)."""
+        n free chips on the node). With chip_pool (a SLICE bundle's
+        reserved chips) the task must land on exactly those chips."""
         need_env = spec.options.get("runtime_env_hash", "")
         if n_chips > 0:
             fresh = None
+            pool_set = set(chip_pool) if chip_pool is not None else None
             for w in self.workers.values():
                 if (w.state != "idle" or w.node_id != node.node_id
                         or w.runtime_env_hash != need_env):
                     continue
                 if w.pinned_chips is not None and len(w.pinned_chips) == n_chips:
+                    if pool_set is not None and not set(w.pinned_chips) <= pool_set:
+                        continue  # pinned outside this bundle's slice
                     return w, w.pinned_chips
                 if w.pinned_chips is None and fresh is None:
                     fresh = w
+            if pool_set is not None:
+                # reserved chips are free iff no live worker pins them
+                # (they never sit in node.free_tpu_chips)
+                live_pinned = {
+                    c
+                    for w in self.workers.values()
+                    if w.node_id == node.node_id and w.pinned_chips
+                    for c in w.pinned_chips
+                }
+                open_chips = [c for c in chip_pool if c not in live_pinned]
+                if fresh is not None and len(open_chips) >= n_chips:
+                    return fresh, tuple(open_chips[:n_chips])
+                return None, ()
             if fresh is not None and len(node.free_tpu_chips) >= n_chips:
                 return fresh, tuple(sorted(node.free_tpu_chips))[:n_chips]
             return None, ()
@@ -1440,7 +1540,7 @@ class Hub:
                     k: v for k, v in spec.options.items()
                     if k in ("max_concurrency", "streaming",
                              "_generator_backpressure_num_objects",
-                             "_restarted")
+                             "_restarted", "placement_group")
                 },
             },
         )
@@ -1911,7 +2011,12 @@ class Hub:
         self.conn_to_worker.pop(worker.conn, None)
         wnode = self.nodes.get(worker.node_id)
         if worker.pinned_chips and wnode is not None:
-            wnode.free_tpu_chips.update(worker.pinned_chips)
+            # chips reserved by a live SLICE PG stay out of the free
+            # pool — they become placeable again through their bundle
+            # (placement checks live-worker pins, not the free pool)
+            wnode.free_tpu_chips.update(
+                set(worker.pinned_chips) - wnode.pg_reserved_chips
+            )
         spec = worker.current_task
         if spec is not None and spec.is_actor_create:
             # actor died mid-constructor: release the creation resources
@@ -2047,6 +2152,30 @@ class Hub:
 
         bundles = p["bundles"]
         strategy = p["strategy"]
+        if strategy == "SLICE":
+            # SLICE must fail loudly where it cannot deliver its promise
+            # (ICI-contiguous chips), never degrade to SPREAD silently
+            for b in bundles:
+                t = b.get("TPU", 0)
+                if t != int(t) or int(t) < 1:
+                    self._reply(
+                        conn, p["req_id"],
+                        error="SLICE bundles must request whole TPU "
+                              f"chips (>=1); got {b}",
+                        pg_id=None,
+                    )
+                    return
+            if not any(
+                n.alive and n.chip_coords for n in self.nodes.values()
+            ):
+                self._reply(
+                    conn, p["req_id"],
+                    error="SLICE requires ICI topology, but no alive "
+                          "node reports chip coordinates (set "
+                          "TPU_TOPOLOGY or TPU_CHIP_COORDS)",
+                    pg_id=None,
+                )
+                return
         if strategy == "STRICT_SPREAD" and len(bundles) > len(
             [n for n in self.nodes.values() if n.alive]
         ):
@@ -2080,6 +2209,9 @@ class Hub:
             return
         nodes = self._ordered_nodes()
         if not nodes:
+            return
+        if entry.strategy == "SLICE":
+            self._try_reserve_slice(entry, nodes)
             return
         snap = {n.node_id: dict(n.avail) for n in nodes}
         assign: List[str] = []
@@ -2121,6 +2253,73 @@ class Hub:
         entry.bundle_nodes = assign
         entry.ready = True
 
+    def _try_reserve_slice(self, entry: PGEntry, nodes: List[NodeEntry]):
+        """SLICE: reserve ICI-contiguous chips. One host => one simple
+        path through the free-chip mesh split into per-bundle chunks;
+        bigger gangs => one bundle per host, each host-contiguous (the
+        cross-host hop rides DCN either way, so only intra-host
+        contiguity matters). The reference has no equivalent — its TPU
+        story stops at pod-name gang resources
+        (python/ray/_private/accelerators/tpu.py:352-375)."""
+        need = [int(b.get("TPU", 0)) for b in entry.bundles]
+        total = sum(need)
+        topo_nodes = [n for n in nodes if n.chip_coords]
+        # 1) whole gang on one host, one contiguous path
+        total_res: Dict[str, float] = {}
+        for b in entry.bundles:
+            for k, v in b.items():
+                total_res[k] = total_res.get(k, 0.0) + v
+        for n in topo_nodes:
+            if not self._resources_fit(total_res, n.avail):
+                continue
+            path = _find_chip_path(n.chip_coords, n.free_tpu_chips, total)
+            if path is None:
+                continue
+            i = 0
+            chunks = []
+            for k in need:
+                chunks.append(tuple(path[i:i + k]))
+                i += k
+            self._commit_slice(entry, [n.node_id] * len(need), chunks)
+            return
+        # 2) one bundle per host, distinct hosts, each chunk contiguous
+        if len(topo_nodes) >= len(entry.bundles):
+            plan: List[Tuple[NodeEntry, tuple]] = []
+            used: Set[str] = set()
+            for b, k in zip(entry.bundles, need):
+                found = None
+                for n in topo_nodes:
+                    if n.node_id in used:
+                        continue
+                    if not self._resources_fit(b, n.avail):
+                        continue
+                    path = _find_chip_path(
+                        n.chip_coords, n.free_tpu_chips, k
+                    )
+                    if path is not None:
+                        found = (n, tuple(path))
+                        break
+                if found is None:
+                    return  # infeasible now; stays pending
+                used.add(found[0].node_id)
+                plan.append(found)
+            self._commit_slice(
+                entry,
+                [n.node_id for n, _ in plan],
+                [chunk for _, chunk in plan],
+            )
+
+    def _commit_slice(self, entry: PGEntry, assign: List[str],
+                      chunks: List[tuple]):
+        for b, nid, chunk in zip(entry.bundles, assign, chunks):
+            node = self.nodes[nid]
+            self._acquire(b, node.avail)
+            node.free_tpu_chips.difference_update(chunk)
+            node.pg_reserved_chips.update(chunk)
+        entry.bundle_nodes = assign
+        entry.bundle_chips = chunks
+        entry.ready = True
+
     def _on_remove_pg(self, conn, p):
         entry = self.pgs.pop(p["pg_id"], None)
         if entry is not None and entry.ready:
@@ -2128,6 +2327,21 @@ class Hub:
                 node = self.nodes.get(nid)
                 if node is not None and node.alive:
                     self._release(b, node.avail)
+            if entry.bundle_chips:
+                for nid, chunk in zip(entry.bundle_nodes, entry.bundle_chips):
+                    node = self.nodes.get(nid)
+                    if node is None:
+                        continue
+                    node.pg_reserved_chips.difference_update(chunk)
+                    # chips still pinned by a live worker return to the
+                    # free pool when that worker dies (see _worker_died)
+                    pinned = {
+                        c
+                        for w in self.workers.values()
+                        if w.node_id == nid and w.pinned_chips
+                        for c in w.pinned_chips
+                    }
+                    node.free_tpu_chips.update(set(chunk) - pinned)
         self._dispatch()
 
     def _on_pg_ready(self, conn, p):
@@ -2232,9 +2446,14 @@ class Hub:
                 })
         elif kind == "placement_groups":
             for g in self.pgs.values():
-                items.append(
-                    {"pg_id": g.pg_id.hex(), "strategy": g.strategy, "ready": g.ready, "bundles": g.bundles}
-                )
+                items.append({
+                    "pg_id": g.pg_id.hex(),
+                    "strategy": g.strategy,
+                    "ready": g.ready,
+                    "bundles": g.bundles,
+                    "bundle_nodes": list(g.bundle_nodes),
+                    "bundle_chips": [list(c) for c in g.bundle_chips],
+                })
         elif kind == "objects":
             for oid, e in self.objects.items():
                 items.append({"object_id": oid.hex(), "ready": e.ready, "size": e.size, "kind": e.kind})

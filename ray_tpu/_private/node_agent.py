@@ -30,6 +30,13 @@ from . import protocol as P
 from .serialization import dumps_inline, loads_inline
 
 
+def _chip_coords(ntpu: int) -> Dict[int, tuple]:
+    """This host's ICI topology for SLICE placement (env-derived)."""
+    from .accelerators.tpu import get_chip_topology
+
+    return get_chip_topology(ntpu) if ntpu else {}
+
+
 class NodeAgent:
     def __init__(self):
         from .client import connect_hub
@@ -69,6 +76,7 @@ class NodeAgent:
                 "session_dir": self.session_dir,
                 "resources": resources,
                 "tpu_chip_ids": list(range(ntpu)),
+                "tpu_chip_coords": _chip_coords(ntpu),
                 "max_workers": int(
                     os.environ.get("RAY_TPU_MAX_WORKERS")
                     or max(4, int(resources["CPU"]))

@@ -133,11 +133,14 @@ def init(
 
         _session_dir = new_session_dir()
         os.makedirs(_session_dir, exist_ok=True)
+        from .accelerators.tpu import get_chip_topology
+
         _hub = Hub(
             _session_dir,
             res,
             max_workers=max_workers,
             tpu_chip_ids=list(range(int(ntpu))) if ntpu else [],
+            tpu_chip_coords=get_chip_topology(int(ntpu)) if ntpu else {},
             worker_env=worker_env,
             # cluster mode: listen on TCP so node agents on other hosts
             # (or simulated hosts in tests) can register

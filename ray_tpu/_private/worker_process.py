@@ -42,6 +42,7 @@ class WorkerRuntime:
         self.fn_cache: Dict[str, Any] = {}
         self.actor_instance: Any = None
         self.actor_id: Optional[bytes] = None
+        self.actor_pg: Optional[tuple] = None  # (pg_id, bundle_idx)
         self.pool: Optional[ThreadPoolExecutor] = None
         self.aio_loop: Optional[asyncio.AbstractEventLoop] = None
 
@@ -165,6 +166,10 @@ class WorkerRuntime:
     def exec_task(self, p: dict):
         if p.get("tpu_chips"):
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in p["tpu_chips"])
+        from ..runtime_context import _current_pg
+
+        pg = (p.get("options") or {}).get("placement_group")
+        _current_pg.set(tuple(pg) if pg else None)
         fn_name = p["fn_id"]
         try:
             fn = self._get_fn(p["fn_id"], p.get("fn_blob"))
@@ -205,6 +210,11 @@ class WorkerRuntime:
             # the hub marks respawned incarnations so user __init__ can
             # branch on was_current_actor_reconstructed
             os.environ["RAY_TPU_ACTOR_RESTARTED"] = "1"
+        from ..runtime_context import _current_pg
+
+        pg = (p.get("options") or {}).get("placement_group")
+        self.actor_pg = tuple(pg) if pg else None
+        _current_pg.set(self.actor_pg)
         try:
             cls = self._get_fn(p["fn_id"], p.get("fn_blob"))
             args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
@@ -226,9 +236,10 @@ class WorkerRuntime:
         # pool threads don't inherit the main loop's contextvars: pin
         # the task id here so get_runtime_context() works under
         # max_concurrency > 1
-        from ..runtime_context import _current_task_id
+        from ..runtime_context import _current_pg, _current_task_id
 
         _current_task_id.set(p.get("task_id"))
+        _current_pg.set(getattr(self, "actor_pg", None))
         method_name = p["method"]
         try:
             if method_name == "__ray_ready__":

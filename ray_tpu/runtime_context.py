@@ -17,6 +17,11 @@ from typing import List, Optional
 _current_task_id: contextvars.ContextVar[Optional[bytes]] = (
     contextvars.ContextVar("ray_tpu_task_id", default=None)
 )
+# (pg_id bytes, bundle_idx) of the currently-executing task, or None;
+# set by the worker executor, read by get_current_placement_group()
+_current_pg: contextvars.ContextVar[Optional[tuple]] = (
+    contextvars.ContextVar("ray_tpu_current_pg", default=None)
+)
 
 
 class RuntimeContext:

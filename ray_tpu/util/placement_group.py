@@ -100,4 +100,27 @@ def placement_group_table() -> dict:
 
 
 def get_current_placement_group() -> Optional[PlacementGroup]:
-    return None  # populated for tasks running inside a PG in a later round
+    """The placement group the calling task/actor runs inside, or None.
+
+    Parity: python/ray/util/placement_group.py
+    get_current_placement_group (reference callers use it for nested
+    scheduling — children placed into the parent's PG). The executor
+    pins (pg_id, bundle) in a contextvar; bundles/strategy come from
+    the hub's PG table.
+    """
+    from ..runtime_context import _current_pg
+
+    cur = _current_pg.get()
+    if cur is None:
+        return None
+    pg_id = cur[0]
+    from .._private import worker
+
+    if not worker.is_initialized():
+        return None
+    for it in worker.get_client().list_state("placement_groups"):
+        if it["pg_id"] == pg_id.hex():
+            return PlacementGroup(
+                PlacementGroupID(pg_id), it["bundles"], it["strategy"]
+            )
+    return None
