@@ -353,6 +353,8 @@ def _setup_runtime_env(client, session_dir: str) -> None:
     renv = json.loads(renv_json)
     for k, v in (renv.get("env_vars") or {}).items():
         os.environ[k] = v
+    if renv.get("pip"):
+        _materialize_pip_env(client, session_dir, renv["pip"])
     uri = renv.get("working_dir_uri")
     if uri:
         import zipfile
@@ -377,6 +379,98 @@ def _setup_runtime_env(client, session_dir: str) -> None:
                 shutil.rmtree(tmp, ignore_errors=True)
         os.chdir(target)
         sys.path.insert(0, target)
+
+
+def _materialize_pip_env(client, session_dir: str, spec: dict) -> None:
+    """Install the env's requirements into a per-node content-hash
+    cached directory and prepend it to sys.path (reference:
+    _private/runtime_env/pip.py virtualenv build + uri_cache.py; here
+    the interpreter is shared, so isolation is an import-path overlay
+    rather than a separate venv — workers only serve matching
+    runtime_env hashes, so cross-env leakage cannot happen).
+
+    Shipped wheels install offline (--no-index --find-links on the KV
+    fetch dir); plain requirements go to the configured index and fail
+    loudly without egress."""
+    import hashlib
+    import json as _json
+    import subprocess
+    import time
+
+    key = _json.dumps(spec, sort_keys=True).encode()
+    env_id = hashlib.sha1(key).hexdigest()[:16]
+    base = os.path.join(session_dir, "runtime_envs")
+    target = os.path.join(base, f"pip_{env_id}")
+    done = os.path.join(target, ".install_done")
+    if not os.path.exists(done):
+        os.makedirs(base, exist_ok=True)
+        lock = os.path.join(base, f"pip_{env_id}.lock")
+        acquired = False
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                acquired = True
+                break
+            except FileExistsError:
+                if os.path.exists(done):
+                    break  # another worker finished the install
+                try:
+                    # break locks orphaned by a killed installer
+                    if time.time() - os.path.getmtime(lock) > 300:
+                        os.unlink(lock)
+                        continue
+                except OSError:
+                    continue  # lock vanished; retry acquisition
+                time.sleep(0.2)
+        if acquired:
+            try:
+                if not os.path.exists(done):
+                    args = [sys.executable, "-m", "pip", "install",
+                            "--quiet", "--no-warn-script-location",
+                            "--target", target]
+                    wheels = spec.get("wheels") or {}  # uri -> filename
+                    wheel_paths = []
+                    for uri, fname in wheels.items():
+                        blob = client.kv_get(
+                            f"__runtime_env_whl__{uri}".encode()
+                        )
+                        if blob is None:
+                            raise RuntimeError(
+                                f"runtime env wheel {fname} missing from KV"
+                            )
+                        # one subdir per content hash: same-named wheels
+                        # with different contents cannot collide
+                        wdir = os.path.join(target, ".wheels", uri)
+                        os.makedirs(wdir, exist_ok=True)
+                        wpath = os.path.join(wdir, fname)
+                        with open(wpath, "wb") as f:
+                            f.write(blob)
+                        wheel_paths.append(wpath)
+                    if wheels and not spec.get("reqs"):
+                        args += ["--no-index"]  # fully offline: wheels only
+                    args += list(spec.get("reqs") or [])
+                    args += wheel_paths
+                    proc = subprocess.run(
+                        args, capture_output=True, text=True, timeout=280
+                    )
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"runtime_env pip install failed:\n{proc.stderr}"
+                        )
+                    with open(done, "w") as f:
+                        f.write(env_id)
+            finally:
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
+        if not os.path.exists(done):
+            raise RuntimeError(
+                f"runtime_env pip install did not complete for {env_id}"
+            )
+    sys.path.insert(0, target)
 
 
 class _LogTee:

@@ -113,6 +113,11 @@ def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# content URIs already uploaded to the cluster KV by this driver process
+# (wheels are content-hashed, so one upload serves every later submit)
+_uploaded_env_uris: set = set()
+
+
 def process_runtime_env(client, opts: Dict[str, Any], out: Dict[str, Any]) -> None:
     """Package a runtime_env for the hub (reference: the runtime-env
     agent's URI flow, _private/runtime_env/agent/runtime_env_agent.py:167
@@ -146,12 +151,58 @@ def process_runtime_env(client, opts: Dict[str, Any], out: Dict[str, Any]) -> No
         client.kv_put(f"__runtime_env_pkg__{uri}".encode(), blob,
                       overwrite=True)
         processed["working_dir_uri"] = uri
-    unknown = set(renv) - {"env_vars", "working_dir"}
+    if renv.get("pip") is not None and renv.get("uv") is not None:
+        raise ValueError(
+            "runtime_env accepts 'pip' OR 'uv', not both"
+        )
+    pip = renv.get("pip") if renv.get("pip") is not None else renv.get("uv")
+    if pip:
+        # reference: _private/runtime_env/pip.py / uv.py — requirements
+        # materialize node-side into a cached env dir. Local wheel/sdist
+        # paths upload once (content-hash URI) into the cluster KV so
+        # every node can install them offline; plain requirement strings
+        # pass through (they need an index reachable from the nodes).
+        if isinstance(pip, dict):
+            pip = pip.get("packages", [])
+        if isinstance(pip, str):
+            # reference form: a requirements.txt path (runtime_env pip
+            # accepts the file path directly)
+            path = os.path.expanduser(pip)
+            if os.path.isfile(path):
+                with open(path) as f:
+                    pip = [
+                        ln.strip() for ln in f
+                        if ln.strip() and not ln.strip().startswith("#")
+                    ]
+            else:
+                pip = [pip]
+        reqs: list = []
+        wheels: Dict[str, str] = {}  # content uri -> original filename
+        for r in pip:
+            r = str(r)
+            path = os.path.expanduser(r)
+            if os.path.isfile(path) and path.endswith(
+                (".whl", ".tar.gz", ".zip")
+            ):
+                with open(path, "rb") as f:
+                    blob = f.read()
+                uri = hashlib.sha1(blob).hexdigest()[:16]
+                if uri not in _uploaded_env_uris:
+                    # upload once per driver; the KV keeps it for nodes
+                    client.kv_put(f"__runtime_env_whl__{uri}".encode(),
+                                  blob, overwrite=True)
+                    _uploaded_env_uris.add(uri)
+                wheels[uri] = os.path.basename(path)
+            else:
+                reqs.append(r)
+        processed["pip"] = {"reqs": sorted(reqs),
+                            "wheels": dict(sorted(wheels.items()))}
+    unknown = set(renv) - {"env_vars", "working_dir", "pip", "uv"}
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)} (supported: "
-            "env_vars, working_dir; pip/conda need egress this "
-            "environment does not have)"
+            "env_vars, working_dir, pip, uv; conda/container need "
+            "tooling this environment does not ship)"
         )
     out["runtime_env"] = processed
     out["runtime_env_hash"] = hashlib.sha1(

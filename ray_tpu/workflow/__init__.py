@@ -92,30 +92,83 @@ def run(dag: DAGNode, *, workflow_id: str, args: Any = None) -> Any:
     os.makedirs(results_dir, exist_ok=True)
     _set_status(workflow_id, "RUNNING")
 
+    # Concurrent executor (reference: workflow_executor.py runs every
+    # in-flight node as a task and reacts to completions): all nodes
+    # whose deps are durable submit IMMEDIATELY — independent branches
+    # overlap; each result is persisted the moment it lands, before any
+    # dependent can observe it.
     schedule = dag._topo()
+    index_of = {node._id: i for i, node in enumerate(schedule)}
+    deps: Dict[int, set] = {
+        n._id: {c._id for c in n._children()} for n in schedule
+    }
+    dependents: Dict[int, List[DAGNode]] = {}
+    for n in schedule:
+        for c in n._children():
+            dependents.setdefault(c._id, []).append(n)
     results: Dict[int, Any] = {}
+    in_flight: Dict[Any, DAGNode] = {}  # ObjectRef -> node
+    started: set = set()
+
+    def _persist(node: DAGNode, value: Any) -> None:
+        path = os.path.join(
+            results_dir, _node_key(node, index_of[node._id]) + ".pkl"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, path)  # durable BEFORE dependents may run
+
+    from collections import deque as _deque
+
+    worklist: "_deque" = _deque()  # nodes whose deps are all in `results`
+
+    def _start(node: DAGNode) -> None:
+        """Deps are all in `results`; run or restore this node.
+        Iterative (worklist, not recursion): restored/passthrough chains
+        can be thousands of nodes deep."""
+        if node._id in started:
+            return
+        started.add(node._id)
+        if isinstance(node, InputNode):
+            _finish(node, args)
+            return
+        if not isinstance(node, FunctionNode):
+            # passthrough nodes (input attributes, multi-output)
+            _finish(node, node._apply(results, (args,), {}))
+            return
+        path = os.path.join(
+            results_dir, _node_key(node, index_of[node._id]) + ".pkl"
+        )
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                _finish(node, cloudpickle.load(f))
+            return
+        in_flight[node._apply(results, (args,), {})] = node
+
+    def _finish(node: DAGNode, value: Any) -> None:
+        results[node._id] = value
+        for dep in dependents.get(node._id, ()):
+            deps[dep._id].discard(node._id)
+            if not deps[dep._id]:
+                worklist.append(dep)
+
+    def _drain() -> None:
+        while worklist:
+            _start(worklist.popleft())
+
     try:
-        for index, node in enumerate(schedule):
-            if isinstance(node, InputNode):
-                results[node._id] = args
-                continue
-            if not isinstance(node, FunctionNode):
-                # passthrough nodes (input attributes, multi-output)
-                results[node._id] = node._apply(results, (args,), {})
-                continue
-            key = _node_key(node, index)
-            path = os.path.join(results_dir, key + ".pkl")
-            if os.path.exists(path):
-                with open(path, "rb") as f:
-                    results[node._id] = cloudpickle.load(f)
-                continue
-            ref = node._apply(results, (args,), {})
-            value = ray_tpu.get(ref)
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                cloudpickle.dump(value, f)
-            os.replace(tmp, path)  # durable BEFORE dependents may run
-            results[node._id] = value
+        for node in schedule:
+            if not deps[node._id]:
+                worklist.append(node)
+        _drain()
+        while in_flight:
+            done, _ = ray_tpu.wait(list(in_flight), num_returns=1)
+            node = in_flight.pop(done[0])
+            value = ray_tpu.get(done[0])
+            _persist(node, value)
+            _finish(node, value)
+            _drain()
     except Exception:
         _set_status(workflow_id, "FAILED")
         raise
