@@ -417,12 +417,16 @@ def _materialize_pip_env(client, session_dir: str, spec: dict) -> None:
                 if os.path.exists(done):
                     break  # another worker finished the install
                 try:
-                    # break locks orphaned by a killed installer
+                    # break locks orphaned by a killed installer; the
+                    # atomic rename means exactly one waiter wins the
+                    # break (unlink-by-path could kill a FRESH lock)
                     if time.time() - os.path.getmtime(lock) > 300:
-                        os.unlink(lock)
+                        claimed = f"{lock}.stale.{os.getpid()}"
+                        os.rename(lock, claimed)
+                        os.unlink(claimed)
                         continue
                 except OSError:
-                    continue  # lock vanished; retry acquisition
+                    continue  # lock vanished or another waiter won
                 time.sleep(0.2)
         if acquired:
             try:

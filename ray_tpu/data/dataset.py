@@ -356,9 +356,29 @@ class Dataset:
         return out
 
     def streaming_split(self, n: int, *, equal: bool = False, locality_hints=None) -> List["Dataset"]:
-        """Reference's streaming_split returns coordinated iterators; on
-        the single-host runtime a materializing split is equivalent."""
-        return self.split(n, equal=equal)
+        """N coordinated consumers over ONE streaming execution
+        (reference: Dataset.streaming_split -> StreamSplitDataIterator
+        + its coordinator actor): blocks are claimed pull-based, so a
+        slow consumer takes fewer blocks and the dataset still drains
+        exactly once per epoch. After all consumers exhaust an epoch,
+        the next pull re-runs the plan (per-epoch re-execution, like
+        the reference's barrier + restarted executor).
+
+        equal=True needs exact splits, which dynamic claiming cannot
+        promise — it materializes and splits statically instead.
+        locality_hints are accepted for API parity; the single-hub
+        runtime has no per-node block placement to exploit yet.
+        """
+        if n <= 0:
+            raise ValueError("n must be positive")
+        if equal:
+            return self.split(n, equal=True)
+        import ray_tpu
+
+        coord = _SplitCoordinator.remote(
+            Dataset(self._logical), n
+        )
+        return [_StreamSplit(coord, cid, n) for cid in builtins.range(n)]
 
     def train_test_split(self, test_size: float, *, shuffle: bool = False, seed=None):
         ds = self.random_shuffle(seed=seed) if shuffle else self
@@ -675,3 +695,104 @@ def read_images(
             return [row]
 
     return read_datasource(ImageDatasource(paths), parallelism=parallelism)
+
+
+# ------------------------------------------------------ streaming_split
+class _SplitCoordinatorImpl:
+    """Owns one streaming execution; consumers claim blocks pull-based.
+
+    Reference: data/_internal/execution/streaming_executor's split
+    coordinator actor (StreamSplitDataIterator): exactly-once block
+    delivery per epoch, epoch barrier before re-execution.
+    """
+
+    def __init__(self, ds, n: int):
+        self._ds = ds
+        self._n = n
+        self._it = None
+        self._exhausted: set = set()
+
+    def next_block(self, consumer_id: int):
+        """One block ref, "__wait__" (epoch barrier), or None (epoch
+        end for this consumer)."""
+        if consumer_id in self._exhausted:
+            # consumer is into its next epoch; wait for the stragglers,
+            # then restart the plan
+            if len(self._exhausted) < self._n:
+                return "__wait__"
+            self._it = None
+            self._exhausted = set()
+        if self._it is None:
+            self._it = iter(self._ds.iter_internal_refs())
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._exhausted.add(consumer_id)
+            return None
+
+
+_split_coordinator_cls = None
+
+
+class _SplitCoordinator:
+    """Lazy ray_tpu.remote wrapper (dataset.py imports before init)."""
+
+    @staticmethod
+    def remote(ds, n: int):
+        global _split_coordinator_cls
+        import ray_tpu
+
+        if _split_coordinator_cls is None:
+            _split_coordinator_cls = ray_tpu.remote(_SplitCoordinatorImpl)
+        return _split_coordinator_cls.remote(ds, n)
+
+
+class _StreamSplit(Dataset):
+    """One consumer's view of a coordinated streaming split.
+
+    Consumption-only (like the reference's StreamSplitDataIterator,
+    which is a DataIterator, not a Dataset): apply transforms BEFORE
+    streaming_split — blocks here come from the shared coordinator, so
+    a per-consumer logical plan would be silently empty.
+    """
+
+    BARRIER_TIMEOUT_S = 600.0
+
+    def __init__(self, coord, consumer_id: int, n: int):
+        super().__init__(L.LogicalPlan(L.FromBlocks(blocks=[])))
+        self._coord = coord
+        self._cid = consumer_id
+        self._n = n
+
+    def _append(self, op):
+        raise TypeError(
+            "streaming_split outputs are consume-only iterators "
+            "(reference: StreamSplitDataIterator); apply transforms to "
+            "the dataset BEFORE streaming_split()"
+        )
+
+    def _block_refs(self):
+        import time
+
+        import ray_tpu
+
+        waited = 0.0
+        while True:
+            out = ray_tpu.get(self._coord.next_block.remote(self._cid))
+            if isinstance(out, str) and out == "__wait__":
+                # epoch barrier: siblings must exhaust the epoch too
+                if waited >= self.BARRIER_TIMEOUT_S:
+                    raise RuntimeError(
+                        f"streaming_split epoch barrier timed out: all "
+                        f"{self._n} consumers must iterate every epoch"
+                    )
+                time.sleep(0.02)
+                waited += 0.02
+                continue
+            waited = 0.0
+            if out is None:
+                return
+            yield out
+
+    def __reduce__(self):
+        return (_StreamSplit, (self._coord, self._cid, self._n))

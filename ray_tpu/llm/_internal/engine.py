@@ -4,24 +4,29 @@ The role vLLM plays for the reference's ray.llm
 (reference: python/ray/llm/_internal/serve/deployments/llm/vllm/) —
 re-designed for XLA instead of wrapped:
 
-- Slot-based continuous batching: a fixed ``max_batch`` of cache slots;
-  every decode step advances ALL active slots in one jitted (B, 1)
-  program (static shapes; no recompiles as requests come and go).
-- Prefill runs per-request at power-of-two bucket lengths, writing the
-  prompt into the slot's cache rows; a handful of bucket sizes bounds
-  total compilations.
-- KV cache is preallocated (L, B, max_seq, KVH, hd); per-slot lengths
-  mask attention (models/llama.py forward_with_cache).
+- Slot-based continuous batching: cache SHARDS of ``max_batch`` slots;
+  every decode step advances one shard's active slots in one jitted
+  (B, 1) program (static shapes; no recompiles as requests come and
+  go). When all slots are busy the engine GROWS by allocating another
+  shard — same compiled programs, more concurrent sequences — up to
+  ``max_slots``.
+- CHUNKED prefill: prompts enter the cache ``prefill_chunk`` tokens per
+  engine step, interleaved with decode — a long prompt cannot stall
+  the decode of already-running sequences (vLLM's chunked-prefill
+  scheduler, reference llm/_internal/batch/stages/vllm_engine_stage.py
+  wraps the same idea). Chunk buckets bound compilations.
+- KV cache is preallocated per shard (L, B, max_seq, KVH, hd);
+  per-slot lengths mask attention (models/llama.py forward_with_cache).
 - Sampling (greedy / temperature) is jitted with the decode step.
 """
 
 from __future__ import annotations
 
 import threading
-import time
+from collections import deque
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -36,8 +41,21 @@ class GenRequest:
     adapter_id: str = ""  # LoRA adapter ("" = base model)
     # filled during generation
     slot: int = -1
+    shard: int = -1
+    prefill_pos: int = 0  # prompt tokens already written to cache
     generated: List[int] = field(default_factory=list)
     done: bool = False
+
+
+@dataclass
+class _Shard:
+    """One (B, max_seq) KV cache block plus its slot bookkeeping."""
+
+    cache: Any
+    lengths: np.ndarray
+    free_slots: List[int]
+    active: Dict[int, GenRequest] = field(default_factory=dict)
+    prefilling: "deque[GenRequest]" = field(default_factory=deque)
 
 
 class LlamaEngine:
@@ -49,6 +67,8 @@ class LlamaEngine:
         max_batch: int = 8,
         max_seq: int = 512,
         seed: int = 0,
+        prefill_chunk: int = 64,
+        max_slots: Optional[int] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -59,27 +79,37 @@ class LlamaEngine:
         self.params = params
         self.max_batch = max_batch
         self.max_seq = max_seq
-        self.cache = llama.init_kv_cache(config, max_batch, max_seq)
-        self.lengths = np.zeros(max_batch, dtype=np.int32)  # tokens in cache
-        self.free_slots = list(range(max_batch))
-        self.active: Dict[int, GenRequest] = {}  # slot -> request
+        # chunk must divide max_seq: chunk starts are then always
+        # aligned and a padded chunk bucket can never run past the
+        # cache end (dynamic_update_slice would CLAMP the start
+        # backward and overwrite earlier valid rows)
+        chunk = min(prefill_chunk, max_seq)
+        while max_seq % chunk:
+            chunk //= 2
+        self.prefill_chunk = max(chunk, 1)
+        # growth is whole-shard; round the cap to shard granularity so
+        # the KV-memory bound it expresses actually holds
+        want_slots = max_slots or 4 * max_batch
+        self.max_slots = max(max_batch, (want_slots // max_batch) * max_batch)
         self._rng = jax.random.PRNGKey(seed)
         self._jax = jax
         self._jnp = jnp
         self._llama = llama
+        self.shards: List[_Shard] = [self._new_shard()]
 
-        # prefill buckets: powers of two up to max_seq
+        # prefill-chunk buckets: powers of two up to prefill_chunk
         self.buckets = []
         b = 16
-        while b < max_seq:
+        while b < self.prefill_chunk:
             self.buckets.append(b)
             b *= 2
-        self.buckets.append(max_seq)
+        self.buckets.append(self.prefill_chunk)
 
         @partial(jax.jit, static_argnames=("bucket",))
         def prefill(params, cache, tokens, slot_onehot, start, length, bucket):
-            # tokens (1, bucket) padded; writes into the slot's rows and
-            # returns logits at the prompt's last real token
+            # tokens (1, bucket) padded; writes into the slot's rows at
+            # offset `start` and returns logits at the chunk's last real
+            # token (used only when the chunk completes the prompt)
             del bucket
             logits, new_cache = llama.forward_with_cache(
                 params, tokens, cache_slice(cache, slot_onehot), start, config
@@ -127,92 +157,160 @@ class LlamaEngine:
         self._decode = decode
         self._lock = threading.Lock()
 
+    def _new_shard(self) -> _Shard:
+        return _Shard(
+            cache=self._llama.init_kv_cache(
+                self.config, self.max_batch, self.max_seq
+            ),
+            lengths=np.zeros(self.max_batch, dtype=np.int32),
+            free_slots=list(range(self.max_batch)),
+        )
+
     # ------------------------------------------------------------------
     def has_capacity(self) -> bool:
-        return bool(self.free_slots)
+        if any(s.free_slots for s in self.shards):
+            return True
+        return len(self.shards) * self.max_batch < self.max_slots
 
     def num_active(self) -> int:
-        return len(self.active)
+        return sum(
+            len(s.active) + len(s.prefilling) for s in self.shards
+        )
+
+    def in_flight_requests(self) -> List[GenRequest]:
+        out: List[GenRequest] = []
+        for s in self.shards:
+            out.extend(s.active.values())
+            out.extend(s.prefilling)
+        return out
+
+    def abort_all(self) -> List[GenRequest]:
+        """Drop every in-flight request (engine fault path); returns
+        them so the caller can fail their waiters."""
+        with self._lock:
+            dropped = self.in_flight_requests()
+            for s in self.shards:
+                for slot in list(s.active):
+                    self._finish(s, slot)
+                while s.prefilling:
+                    req = s.prefilling.popleft()
+                    req.done = True
+                    s.lengths[req.slot] = 0
+                    s.free_slots.append(req.slot)
+            return dropped
 
     def add_request(self, req: GenRequest) -> bool:
-        """Admit a request into a free slot (prefill immediately)."""
-        import numpy as np
-
+        """Admit into a free slot. No model compute happens here — the
+        prompt prefills chunk-by-chunk inside step(), interleaved with
+        decode, so admission never stalls running sequences."""
         with self._lock:
-            if not self.free_slots:
-                return False
             if len(req.prompt_ids) >= self.max_seq:
                 raise ValueError(
                     f"prompt length {len(req.prompt_ids)} >= max_seq {self.max_seq}"
                 )
-            slot = self.free_slots.pop()
-            req.slot = slot
-            n = len(req.prompt_ids)
-            bucket = next(b for b in self.buckets if b >= n)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = req.prompt_ids
-            onehot = np.zeros(self.max_batch, np.float32)
-            onehot[slot] = 1.0
-            last_logits, self.cache = self._prefill(
-                self.params, self.cache, tokens, onehot,
-                np.zeros(1, np.int32), n, bucket=bucket,
+            si = next(
+                (i for i, s in enumerate(self.shards) if s.free_slots), None
             )
-            # first generated token comes from the prompt's last logits
-            lg = np.asarray(last_logits)
-            if req.temperature > 0:
-                self._rng, sub = self._jax.random.split(self._rng)
-                tok = int(self._jax.random.categorical(
-                    sub, self._jnp.asarray(lg) / max(req.temperature, 1e-4)))
-            else:
-                tok = int(lg.argmax())
-            req.generated.append(tok)
-            self.lengths[slot] = n
-            self.active[slot] = req
-            if req.eos_id is not None and tok == req.eos_id:
-                self._finish(slot)
-            elif len(req.generated) >= req.max_tokens:
-                self._finish(slot)
+            if si is None:
+                if len(self.shards) * self.max_batch >= self.max_slots:
+                    return False
+                self.shards.append(self._new_shard())  # slot growth
+                si = len(self.shards) - 1
+            shard = self.shards[si]
+            req.slot = shard.free_slots.pop()
+            req.shard = si
+            req.prefill_pos = 0
+            shard.prefilling.append(req)
             return True
 
-    def _finish(self, slot: int):
-        req = self.active.pop(slot)
+    def _finish(self, shard: _Shard, slot: int):
+        req = shard.active.pop(slot)
         req.done = True
-        self.lengths[slot] = 0
-        self.free_slots.append(slot)
+        shard.lengths[slot] = 0
+        shard.free_slots.append(slot)
+
+    def _pump_prefill(self, shard: _Shard, out: List[Tuple[GenRequest, int]]):
+        """Write ONE chunk of the oldest pending prompt into the cache;
+        on prompt completion, sample the first token and activate the
+        slot for decoding."""
+        if not shard.prefilling:
+            return
+        req = shard.prefilling[0]
+        n = len(req.prompt_ids)
+        pos = req.prefill_pos
+        chunk = min(self.prefill_chunk, n - pos)
+        bucket = next(b for b in self.buckets if b >= chunk)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :chunk] = req.prompt_ids[pos:pos + chunk]
+        onehot = np.zeros(self.max_batch, np.float32)
+        onehot[req.slot] = 1.0
+        last_logits, shard.cache = self._prefill(
+            self.params, shard.cache, tokens, onehot,
+            np.asarray([pos], np.int32), chunk, bucket=bucket,
+        )
+        req.prefill_pos = pos + chunk
+        if req.prefill_pos < n:
+            return
+        # prompt complete: first generated token from the last logits
+        shard.prefilling.popleft()
+        lg = np.asarray(last_logits)
+        if req.temperature > 0:
+            self._rng, sub = self._jax.random.split(self._rng)
+            tok = int(self._jax.random.categorical(
+                sub, self._jnp.asarray(lg) / max(req.temperature, 1e-4)))
+        else:
+            tok = int(lg.argmax())
+        req.generated.append(tok)
+        shard.lengths[req.slot] = n
+        shard.active[req.slot] = req
+        out.append((req, tok))
+        if (req.eos_id is not None and tok == req.eos_id) or (
+            len(req.generated) >= req.max_tokens
+        ):
+            self._finish(shard, req.slot)
 
     def step(self) -> List[Tuple[GenRequest, int]]:
-        """One decode step for every active slot. Returns (request,
-        new_token) pairs emitted this step (callers stream them out)."""
-        import numpy as np
-
+        """One engine step: per shard, one prefill chunk (if a prompt is
+        pending) then one decode for every active slot. Returns
+        (request, new_token) pairs emitted this step — the FIRST token
+        of a request (sampled off its prefill) arrives here too."""
         with self._lock:
-            if not self.active:
-                return []
-            last = np.zeros(self.max_batch, np.int32)
-            temps = np.zeros(self.max_batch, np.float32)
-            for slot, req in self.active.items():
-                last[slot] = req.generated[-1]
-                temps[slot] = req.temperature
-            toks, self.cache, self._rng = self._decode(
-                self.params, self.cache, last,
-                self.lengths, temps, self._rng,
-            )
-            toks = np.asarray(toks)
-            out = []
-            for slot in list(self.active.keys()):
-                req = self.active[slot]
-                # the decode consumed the previous token: account it
-                self.lengths[slot] += 1
-                tok = int(toks[slot])
-                req.generated.append(tok)
-                out.append((req, tok))
-                total_len = self.lengths[slot] + 1
-                if (
-                    (req.eos_id is not None and tok == req.eos_id)
-                    or len(req.generated) >= req.max_tokens
-                    or total_len >= self.max_seq - 1
-                ):
-                    self._finish(slot)
+            out: List[Tuple[GenRequest, int]] = []
+            for shard in self.shards:
+                self._pump_prefill(shard, out)
+                if not shard.active:
+                    continue
+                last = np.zeros(self.max_batch, np.int32)
+                temps = np.zeros(self.max_batch, np.float32)
+                # inactive lanes (free or mid-prefill) still ride the
+                # batched decode; point their cache write at the scratch
+                # row (max_seq-1, provably never attended: sequences
+                # finish before reaching it) so they cannot corrupt a
+                # half-prefilled prompt's rows
+                lens = np.full(self.max_batch, self.max_seq - 1, np.int32)
+                for slot, req in shard.active.items():
+                    last[slot] = req.generated[-1]
+                    temps[slot] = req.temperature
+                    lens[slot] = shard.lengths[slot]
+                toks, shard.cache, self._rng = self._decode(
+                    self.params, shard.cache, last,
+                    lens, temps, self._rng,
+                )
+                toks = np.asarray(toks)
+                for slot in list(shard.active.keys()):
+                    req = shard.active[slot]
+                    # the decode consumed the previous token: account it
+                    shard.lengths[slot] += 1
+                    tok = int(toks[slot])
+                    req.generated.append(tok)
+                    out.append((req, tok))
+                    total_len = shard.lengths[slot] + 1
+                    if (
+                        (req.eos_id is not None and tok == req.eos_id)
+                        or len(req.generated) >= req.max_tokens
+                        or total_len >= self.max_seq - 1
+                    ):
+                        self._finish(shard, slot)
             return out
 
     # ------------------------------------------------------------------

@@ -198,11 +198,8 @@ class LLMServer:
                         q.put(("error", e))
                     continue
                 admitted = True
-                # prefill may already finish the request (max_tokens=1)
-                if q is not None:
-                    q.put(("token", req.generated[0]))
-                    if req.done:
-                        q.put(("done", None))
+                # the first token arrives from step() once the chunked
+                # prefill completes — nothing to emit at admission
             for req in requeue:
                 self._pending.put(req)
             stepped = False
@@ -215,13 +212,11 @@ class LLMServer:
                 try:
                     emitted = eng.step()
                 except Exception as e:
-                    # engine fault: fail every active request, keep serving
-                    for slot in list(eng.active):
-                        req = eng.active[slot]
+                    # engine fault: fail every in-flight request, keep serving
+                    for req in eng.abort_all():
                         q = self._token_queues.get(req.request_id)
                         if q is not None:
                             q.put(("error", e))
-                        eng._finish(slot)
                     continue
                 for req, tok in emitted:
                     q = self._token_queues.get(req.request_id)
@@ -322,8 +317,11 @@ class LLMServer:
     def engine_stats(self) -> Dict[str, Any]:
         return {
             "active": self.engine.num_active(),
-            "free_slots": len(self.engine.free_slots),
+            "free_slots": sum(
+                len(s.free_slots) for s in self.engine.shards
+            ),
             "max_batch": self.engine.max_batch,
+            "shards": len(self.engine.shards),
         }
 
 

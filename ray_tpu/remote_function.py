@@ -113,9 +113,15 @@ def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-# content URIs already uploaded to the cluster KV by this driver process
-# (wheels are content-hashed, so one upload serves every later submit)
-_uploaded_env_uris: set = set()
+def _uploaded_env_uris(client) -> set:
+    """Per-CLIENT memo of wheel URIs already uploaded (content-hashed,
+    one upload serves every later submit). Keyed on the client object:
+    a new cluster connection starts empty, so a fresh hub's KV gets the
+    wheels again."""
+    memo = getattr(client, "_env_upload_memo", None)
+    if memo is None:
+        memo = client._env_upload_memo = set()
+    return memo
 
 
 def process_runtime_env(client, opts: Dict[str, Any], out: Dict[str, Any]) -> None:
@@ -178,20 +184,28 @@ def process_runtime_env(client, opts: Dict[str, Any], out: Dict[str, Any]) -> No
                 pip = [pip]
         reqs: list = []
         wheels: Dict[str, str] = {}  # content uri -> original filename
+        memo = _uploaded_env_uris(client)
         for r in pip:
             r = str(r)
             path = os.path.expanduser(r)
             if os.path.isfile(path) and path.endswith(
-                (".whl", ".tar.gz", ".zip")
+                (".tar.gz", ".zip")
             ):
+                # sdists need a build backend (setuptools) pip would
+                # fetch from an index — impossible on egress-less nodes
+                raise ValueError(
+                    f"runtime_env pip: ship built wheels, not sdists "
+                    f"({r}); run `pip wheel {r}` first"
+                )
+            if os.path.isfile(path) and path.endswith(".whl"):
                 with open(path, "rb") as f:
                     blob = f.read()
                 uri = hashlib.sha1(blob).hexdigest()[:16]
-                if uri not in _uploaded_env_uris:
-                    # upload once per driver; the KV keeps it for nodes
+                if uri not in memo:
+                    # upload once per client; the KV keeps it for nodes
                     client.kv_put(f"__runtime_env_whl__{uri}".encode(),
                                   blob, overwrite=True)
-                    _uploaded_env_uris.add(uri)
+                    memo.add(uri)
                 wheels[uri] = os.path.basename(path)
             else:
                 reqs.append(r)

@@ -186,6 +186,46 @@ class TestConsumption:
         b = rd.range(100).take_batch(5)
         np.testing.assert_array_equal(b["id"], np.arange(5))
 
+    def test_streaming_split_coordinated(self, ray4):
+        """One execution feeds N pull-based consumers: uneven consumers
+        drain the dataset exactly once, the fast consumer claims more,
+        and the next epoch re-executes fully (reference:
+        Dataset.streaming_split coordination)."""
+        import threading
+        import time
+
+        splits = rd.range(80).map_batches(
+            lambda b: b, batch_size=8
+        ).streaming_split(2)
+        got = {0: [], 1: []}
+
+        def consume(i, delay):
+            for row in splits[i].iter_rows():
+                got[i].append(row["id"])
+                time.sleep(delay)
+
+        ts = [
+            threading.Thread(target=consume, args=(0, 0.0)),
+            threading.Thread(target=consume, args=(1, 0.02)),
+        ]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert sorted(got[0] + got[1]) == list(range(80))  # exactly once
+        assert len(got[0]) > len(got[1])  # demand-balanced
+        # epoch 2: the plan re-executes and drains fully again
+        epoch2 = []
+
+        def consume2(i):
+            for row in splits[i].iter_rows():
+                epoch2.append(row["id"])
+
+        ts = [
+            threading.Thread(target=consume2, args=(i,)) for i in (0, 1)
+        ]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert sorted(epoch2) == list(range(80))
+
     def test_iter_torch_batches(self, ray4):
         import torch
 
