@@ -112,9 +112,13 @@ def main() -> None:
             ray_tpu.get(refs)
             return n
 
-        def arg_batch(self, n, arg_ref):
+        def arg_batch(self, n):
+            # reference shape (ray_perf.py:51 small_value_batch_arg):
+            # put a SMALL value once per batch, pass the REF to every
+            # call on every server
+            x = ray_tpu.put(0)
             ray_tpu.get(
-                [t.sink.remote(arg_ref) for t in self.targets for _ in range(n)]
+                [t.sink.remote(x) for t in self.targets for _ in range(n)]
             )
             return n * len(self.targets)
 
@@ -202,22 +206,21 @@ def main() -> None:
 
     report("n_n_actor_calls_async", timeit(n_n_async), "calls/s")
 
-    arg = np.zeros(1024 * 1024, dtype=np.uint8)  # 1 MiB like the reference
-    arg_ref = ray_tpu.put(arg)
     N_ARG = N_ASYNC // 10
 
-    # paired client->actor processes passing a shared 1 MiB object ref
-    # (reference shape: "n:n actor calls with arg async" — one Client
-    # per server actor, Client.small_value_batch_arg)
-    arg_clients = [Client.remote([a]) for a in actors]
-    ray_tpu.get([c.arg_batch.remote(1, arg_ref) for c in arg_clients])
+    # client processes each putting a small object and fanning the ref
+    # out to every server actor (reference shape: "n:n actor calls with
+    # arg async" — Client.small_value_batch_arg over all servers,
+    # ray_perf.py:51,238)
+    arg_clients = [Client.remote(actors) for _ in range(n_actors)]
+    ray_tpu.get([c.arg_batch.remote(1) for c in arg_clients])
+    per_client = max(1, N_ARG // (n_actors * n_actors))
 
     def n_n_with_arg():
         ray_tpu.get(
-            [c.arg_batch.remote(N_ARG // n_actors, arg_ref)
-             for c in arg_clients]
+            [c.arg_batch.remote(per_client) for c in arg_clients]
         )
-        return N_ARG
+        return per_client * n_actors * n_actors
 
     report("n_n_actor_calls_with_arg_async", timeit(n_n_with_arg), "calls/s")
 

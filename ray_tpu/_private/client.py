@@ -151,44 +151,12 @@ class CoreClient:
                     # and must propagate.
                     raise EOFError("connection closed during recv")
                 msg_type, payload = loads_inline(blob)
-                if msg_type == P.REPLY:
-                    req_id = payload["req_id"]
-                    with self._pending_lock:
-                        fut = self._pending.pop(req_id, None)
-                    if fut is not None:
-                        fut.set_result(payload)
-                elif msg_type == P.PUBSUB_MSG:
-                    cb = self.subscriptions.get(payload["channel"])
-                    if cb is not None:
-                        try:
-                            cb(payload["data"])
-                        except Exception:
-                            pass
-                elif msg_type == P.CANCEL_TASK:
-                    # reader-thread fast path: mark before the executor
-                    # dequeues it AND resolve the caller immediately —
-                    # the executor may be busy for a long time before it
-                    # ever sees the queued message (it drops it silently
-                    # at dequeue; a late duplicate TASK_DONE is ignored
-                    # because error objects are first-write-wins)
-                    self.cancelled_tasks.add(payload["task_id"])
-                    if payload.get("return_ids"):
-                        blob = dumps_inline(
-                            exceptions.TaskCancelledError("task was cancelled")
-                        )
-                        self.send(
-                            P.TASK_DONE,
-                            {
-                                "task_id": payload["task_id"],
-                                "returns": [
-                                    (oid, P.VAL_ERROR, blob, 0)
-                                    for oid in payload["return_ids"]
-                                ],
-                            },
-                        )
-                else:
-                    # Task assignment (worker role) or control message.
-                    self.task_queue.put((msg_type, payload))
+                if msg_type == "batch":
+                    # hub reactor coalesces its per-peer sends (hub._send)
+                    for mt, pl in payload:
+                        self._dispatch_inbound(mt, pl)
+                    continue
+                self._dispatch_inbound(msg_type, payload)
         except (EOFError, OSError):
             self._closed = True
             with self._pending_lock:
@@ -197,6 +165,46 @@ class CoreClient:
                 if not fut.done():
                     fut.set_exception(ConnectionError("hub connection lost"))
             self.task_queue.put((P.KILL, {}))
+
+    def _dispatch_inbound(self, msg_type, payload):
+        if msg_type == P.REPLY:
+            req_id = payload["req_id"]
+            with self._pending_lock:
+                fut = self._pending.pop(req_id, None)
+            if fut is not None:
+                fut.set_result(payload)
+        elif msg_type == P.PUBSUB_MSG:
+            cb = self.subscriptions.get(payload["channel"])
+            if cb is not None:
+                try:
+                    cb(payload["data"])
+                except Exception:
+                    pass
+        elif msg_type == P.CANCEL_TASK:
+            # reader-thread fast path: mark before the executor
+            # dequeues it AND resolve the caller immediately —
+            # the executor may be busy for a long time before it
+            # ever sees the queued message (it drops it silently
+            # at dequeue; a late duplicate TASK_DONE is ignored
+            # because error objects are first-write-wins)
+            self.cancelled_tasks.add(payload["task_id"])
+            if payload.get("return_ids"):
+                blob = dumps_inline(
+                    exceptions.TaskCancelledError("task was cancelled")
+                )
+                self.send(
+                    P.TASK_DONE,
+                    {
+                        "task_id": payload["task_id"],
+                        "returns": [
+                            (oid, P.VAL_ERROR, blob, 0)
+                            for oid in payload["return_ids"]
+                        ],
+                    },
+                )
+        else:
+            # Task assignment (worker role) or control message.
+            self.task_queue.put((msg_type, payload))
 
     # Request types safe to retransmit when a reply is slow/lost: reads
     # and idempotent writes. Lost-message tolerance is what the chaos

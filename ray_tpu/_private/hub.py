@@ -75,6 +75,13 @@ class ObjEntry:
     spilled: bool = False  # primary copy moved to disk (LRU eviction)
     # (conn, req_id) waiters registered by pending GETs
     task_waiters: List[bytes] = field(default_factory=list)  # task_ids blocked on this obj
+    # dependency pins: in-flight tasks (and live actors, for creation
+    # args) holding this object alive against ownership-GC release.
+    # Mirrors the reference's "submitted task references"
+    # (src/ray/core_worker/reference_count.h) without per-borrower
+    # bookkeeping: the hub sees every submit, so it counts directly.
+    pins: int = 0
+    release_pending: bool = False  # owner released while pinned
 
 
 @dataclass
@@ -123,6 +130,9 @@ class TaskSpec:
     actor_id: Optional[bytes] = None  # for actor tasks
     method: Optional[str] = None
     ready_id: Optional[bytes] = None  # actor creation ready object
+    # arg object ids pinned for this task's lifetime (cleared on unpin
+    # so finalization paths can safely run more than once)
+    pinned_deps: List[bytes] = field(default_factory=list)
 
 
 @dataclass
@@ -159,6 +169,10 @@ class ActorEntry:
     pending_calls: deque = field(default_factory=deque)
     inflight: Dict[bytes, TaskSpec] = field(default_factory=dict)  # task_id -> spec
     pool: Optional[tuple] = None  # resource pool holding the actor's lifetime resources
+    # creation-arg object pins, held for the actor's lifetime so a
+    # restart can replay the creation args; released when the actor is
+    # permanently dead
+    creation_pins: List[bytes] = field(default_factory=list)
 
 
 @dataclass
@@ -399,6 +413,7 @@ class Hub:
         self._dispatching = False
         self._dispatch_pending = False
         self._pg_counter = itertools.count(1)
+        self._outbox: Dict[Any, List[tuple]] = {}
         self._shutdown_evt = threading.Event()
         self.thread = threading.Thread(target=self._run, daemon=True, name="ray-tpu-hub")
 
@@ -407,10 +422,29 @@ class Hub:
         self.thread.start()
 
     def _send(self, conn, msg_type: str, payload: dict):
-        try:
-            conn.send_bytes(dumps_inline((msg_type, payload)))
-        except (OSError, BrokenPipeError, EOFError):
-            pass
+        """Buffered send: messages accumulate per connection and are
+        flushed after the current inbound message is handled (one
+        pickle + one syscall per peer per handled message). A blocking
+        pipe write to a slow peer then stalls the reactor once per
+        batch instead of once per task — the same reason the
+        reference's raylet sends through an asio write queue."""
+        q = self._outbox.get(conn)
+        if q is None:
+            q = self._outbox[conn] = []
+        q.append((msg_type, payload))
+
+    def _flush_outbox(self):
+        if not self._outbox:
+            return
+        outbox, self._outbox = self._outbox, {}
+        for conn, msgs in outbox.items():
+            try:
+                if len(msgs) == 1:
+                    conn.send_bytes(dumps_inline(msgs[0]))
+                else:
+                    conn.send_bytes(dumps_inline(("batch", msgs)))
+            except (OSError, BrokenPipeError, EOFError):
+                pass
 
     def _reply(self, conn, req_id: int, **payload):
         self._send(conn, P.REPLY, dict(payload, req_id=req_id))
@@ -434,6 +468,7 @@ class Hub:
                     sys.stderr.write(
                         f"[ray_tpu] hub timer error:\n{traceback.format_exc()}\n"
                     )
+            self._flush_outbox()
             timeout = None
             if self.timers:
                 timeout = max(0.0, self.timers[0][0] - time.monotonic())
@@ -457,6 +492,7 @@ class Hub:
                                 f"[ray_tpu] hub handler error on {msg_type}:\n"
                                 f"{traceback.format_exc()}\n"
                             )
+                        self._flush_outbox()
                         if not r.poll(0):
                             break
                 except (EOFError, OSError):
@@ -466,6 +502,7 @@ class Hub:
             self._kill_worker(w)
         for conn in list(self.agent_conns):
             self._send(conn, P.KILL, {})
+        self._flush_outbox()
         try:
             self.listener.close()
         except Exception:
@@ -618,6 +655,7 @@ class Hub:
                         blob = dumps_inline(ActorDiedError(msg="Actor is dead."))
                         for roid in spec.return_ids:
                             self._object_ready(roid, P.VAL_ERROR, blob, 0)
+                        self._unpin_deps(spec)
                     else:
                         self._route_actor_call(actor, spec)
                 else:
@@ -639,9 +677,13 @@ class Hub:
             if req.n_ready >= req.num_returns:
                 self._fulfill_wait(req)
         # ownership GC: the owner released this ref before the value
-        # arrived — nothing can fetch it, free right away
+        # arrived — nothing can fetch it, free right away (unless an
+        # in-flight task pinned it as an arg)
         if self._released_early.pop(oid, None):
-            self._free_ids([oid])
+            if e.pins > 0:
+                e.release_pending = True
+            else:
+                self._free_ids([oid])
         self._dispatch()
 
     # ---- shm budget: LRU accounting + disk spill (reference: plasma
@@ -849,6 +891,11 @@ class Hub:
                         next(iter(self._released_early))
                     )
                 continue
+            if e.pins > 0:
+                # in-flight task (or live actor) still depends on this
+                # object: defer the free to the last unpin
+                e.release_pending = True
+                continue
             if (
                 self.obj_get_waiters.get(oid)
                 or self.obj_wait_waiters.get(oid)
@@ -856,6 +903,24 @@ class Hub:
             ):
                 continue  # defensive: someone is mid-get; keep it
             self._free_ids([oid])
+
+    def _unpin_deps(self, spec: Optional[TaskSpec]):
+        """Drop a finalized task's dependency pins; free objects whose
+        owner already released them. Idempotent (pinned_deps is
+        consumed) so overlapping finalization paths are safe."""
+        if spec is None or not spec.pinned_deps:
+            return
+        deps, spec.pinned_deps = spec.pinned_deps, []
+        self._unpin_ids(deps)
+
+    def _unpin_ids(self, ids: List[bytes]):
+        for oid in ids:
+            e = self.objects.get(oid)
+            if e is None:
+                continue
+            e.pins -= 1
+            if e.pins <= 0 and e.release_pending and e.ready:
+                self._free_ids([oid])
 
     def _on_free(self, conn, p):
         self._free_ids(p["object_ids"])
@@ -1193,6 +1258,8 @@ class Hub:
             e = self.objects.get(dep)
             if e is None:
                 e = self.objects[dep] = ObjEntry()
+            e.pins += 1
+            spec.pinned_deps.append(dep)
             if not e.ready:
                 pending += 1
                 self.dep_waiters.setdefault(dep, []).append(spec)
@@ -1413,15 +1480,17 @@ class Hub:
                 # pin: chips leave the node's free pool for the worker's life
                 node.free_tpu_chips.difference_update(chips)
                 worker.pinned_chips = chips
-            was_warm = bool(worker.seen_fns)
             self._send_exec(worker, spec, chips)
-            if spec.is_actor_create and was_warm:
-                # the actor just pinned a WARM task worker for life (it
-                # has task history — a fresh spawn has none); restore the
-                # pool to its prior size so the next task burst doesn't
-                # pay cold worker-spawn latency (reference: the raylet
-                # prestarts replacement workers when actors take pool
-                # members, worker_pool.cc PrestartWorkers)
+            if spec.is_actor_create:
+                # the actor just pinned a pool member for life; restore
+                # the pool to its prior size so the next task burst
+                # doesn't pay cold worker-spawn latency (reference: the
+                # raylet prestarts replacement workers when actors take
+                # pool members, worker_pool.cc PrestartWorkers). Every
+                # claim replenishes — gating on worker warmth let a
+                # burst of actor creations drain the pool to zero (each
+                # replacement is fresh, so its claim replenished
+                # nothing).
                 pooled = self._node_worker_count(node.node_id)
                 if pooled + node.spawning < node.max_workers:
                     # replenish with the SAME runtime env the claimed
@@ -1678,6 +1747,11 @@ class Hub:
         if self._maybe_retry_app_error(spec, p["returns"]):
             self._dispatch()
             return
+        if spec is not None and not spec.is_actor_create:
+            # actor-creation pins persist for the actor's lifetime
+            # (restart replays the creation args); everything else
+            # unpins on final completion
+            self._unpin_deps(spec)
         if spec is not None and spec.actor_id is None and not spec.is_actor_create:
             for oid, kind, _, _ in p["returns"]:
                 if kind == P.VAL_SHM:
@@ -1761,6 +1835,7 @@ class Hub:
         self._task_event(spec.task_id, state="FAILED", finished_at=time.time(),
                          error=str(err)[:200])
         self.tasks.pop(spec.task_id, None)
+        self._unpin_deps(spec)
 
     # ----- actors
     def _on_create_actor(self, conn, p):
@@ -1811,6 +1886,7 @@ class Hub:
             actor.state = "dead"
             if spec is not None:
                 self._release_task_resources(spec)
+                self._unpin_deps(spec)
             worker.state = "idle"
             worker.actor_id = None
             worker.tpu_chips = ()  # chips remain pinned to the worker
@@ -1820,6 +1896,13 @@ class Hub:
             return
         actor.state = "alive"
         actor.worker_id = wid
+        # the creation spec is finalized but its arg pins must survive
+        # for the actor's lifetime (restart replays the creation args):
+        # transfer them to the actor entry. A restart's respawn spec
+        # skips _admit, so pins are never doubled.
+        if spec is not None and spec.pinned_deps:
+            actor.creation_pins.extend(spec.pinned_deps)
+            spec.pinned_deps = []
         worker.state = "actor"
         worker.actor_id = actor.actor_id
         worker.current_task = None
@@ -1857,6 +1940,8 @@ class Hub:
             e = self.objects.get(dep)
             if e is None:
                 e = self.objects[dep] = ObjEntry()
+            e.pins += 1
+            spec.pinned_deps.append(dep)
             if not e.ready:
                 pending += 1
                 self.dep_waiters.setdefault(dep, []).append(spec)
@@ -1911,12 +1996,18 @@ class Hub:
                 self._object_ready(oid, P.VAL_ERROR, blob, 0)
             if spec.options.get("streaming"):
                 self._end_stream_with_error(spec.task_id, blob)
+            self._unpin_deps(spec)
         for spec in actor.inflight.values():
             for oid in spec.return_ids:
                 self._object_ready(oid, P.VAL_ERROR, blob, 0)
             if spec.options.get("streaming"):
                 self._end_stream_with_error(spec.task_id, blob)
+            self._unpin_deps(spec)
         actor.inflight.clear()
+        # the actor is permanently dead here on every call path: drop
+        # the creation-arg pins
+        self._unpin_ids(actor.creation_pins)
+        actor.creation_pins = []
 
     def _on_kill_actor(self, conn, p):
         actor = self.actors.get(p["actor_id"])
@@ -1945,6 +2036,7 @@ class Hub:
                 q = self.runnable.get(key)
                 if q is not None and spec in q:
                     q.remove(spec)
+                self._unpin_deps(spec)
             actor.state = "dead"
             blob = dumps_inline(ActorDiedError(msg="The actor was killed before it started."))
             self._object_ready(actor.ready_id, P.VAL_ERROR, blob, 0)
@@ -1964,6 +2056,7 @@ class Hub:
     def _handle_disconnect(self, conn):
         if conn in self.client_conns:
             self.client_conns.remove(conn)
+        self._outbox.pop(conn, None)
         for subs in self.subscribers.values():
             if conn in subs:
                 subs.remove(conn)
@@ -2042,6 +2135,13 @@ class Hub:
             actor_id = worker.actor_id or spec.actor_id
             actor = self.actors.get(actor_id)
             if actor is not None:
+                if spec is not None and spec.is_actor_create and spec.pinned_deps:
+                    # constructor died before _on_actor_ready transferred
+                    # the creation-arg pins: move them to the actor entry
+                    # so a restart keeps the args and permanent death
+                    # (_drain_actor_queue_with_error) releases them
+                    actor.creation_pins.extend(spec.pinned_deps)
+                    spec.pinned_deps = []
                 # release actor lifetime resources to the pool they came from
                 if actor.state == "alive":
                     if actor.pool is not None and actor.pool[0] == "pg":
@@ -2067,6 +2167,7 @@ class Hub:
                             self._object_ready(oid, P.VAL_ERROR, blob, 0)
                         if s.options.get("streaming"):
                             self._end_stream_with_error(s.task_id, blob)
+                        self._unpin_deps(s)
                     actor.inflight.clear()
                     respawn_opts = dict(actor.options)
                     # the new incarnation can tell it is a restart
@@ -2089,6 +2190,10 @@ class Hub:
                 else:
                     actor.state = "dead"
                     self._drain_actor_queue_with_error(actor)
+            else:
+                # actor entry already gone: nothing can restart, drop
+                # any creation-arg pins still on the spec
+                self._unpin_deps(spec)
         self._dispatch()
 
     def _on_cancel(self, conn, p):

@@ -105,7 +105,12 @@ class NodeAgent:
         finally:
             self._shutdown()
 
-    def _handle(self, msg_type: str, p: dict) -> None:
+    def _handle(self, msg_type: str, p) -> None:
+        if msg_type == "batch":
+            # hub reactor coalesces its per-peer sends (hub._send)
+            for mt, pl in p:
+                self._handle(mt, pl)
+            return
         if msg_type == P.SPAWN_WORKER:
             env = dict(os.environ)
             env.update(p["env"])

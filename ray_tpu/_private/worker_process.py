@@ -187,7 +187,18 @@ class WorkerRuntime:
                 self._stream_fail(p, fn_name)
                 return
             returns = self._error_returns(p["return_ids"], fn_name)
-        self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
+        self._send_done({"task_id": p["task_id"], "returns": returns})
+
+    def _send_done(self, payload: dict) -> None:
+        """TASK_DONE with load-adaptive batching: while more work is
+        queued, completions ride the async buffer (the next send — or
+        the flusher — coalesces them into one hub message); when the
+        queue is empty, send immediately for latency. send() flushes
+        the buffer first, so completion order is preserved."""
+        if self.client.task_queue.qsize() > 0:
+            self.client.send_async(P.TASK_DONE, payload)
+        else:
+            self.client.send(P.TASK_DONE, payload)
 
     def reply_cancelled(self, p: dict) -> None:
         # the reader thread already resolved the caller (CANCEL_TASK
@@ -272,7 +283,7 @@ class WorkerRuntime:
                 self._stream_fail(p, method_name)
                 return
             returns = self._error_returns(p["return_ids"], method_name)
-        self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
+        self._send_done({"task_id": p["task_id"], "returns": returns})
 
     def _ensure_aio_loop(self):
         if self.aio_loop is None:
@@ -331,7 +342,7 @@ class WorkerRuntime:
                     returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
                 except Exception:
                     returns = self._error_returns(p["return_ids"], p["method"])
-                self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": returns})
+                self._send_done({"task_id": p["task_id"], "returns": returns})
 
             asyncio.run_coroutine_threadsafe(run(), loop)
         elif self.pool is not None:
@@ -550,6 +561,13 @@ def main():
             if isinstance(payload, dict) and "task_id" in payload:
                 _current_task_id.set(payload["task_id"])
             if msg_type == P.KILL:
+                # a just-finished task's TASK_DONE may still sit in the
+                # async send buffer (_send_done batching) — flush so the
+                # hub never retries a task that already completed
+                try:
+                    client.flush()
+                except Exception:
+                    pass
                 os._exit(0)
             elif msg_type in (P.EXEC_TASK, P.EXEC_ACTOR_TASK) and (
                 payload["task_id"] in client.cancelled_tasks

@@ -38,7 +38,7 @@ class ActorMethod:
         from ._private import worker
 
         client = worker.get_client()
-        args_kind, args_payload, deps = encode_args(client, args, kwargs)
+        args_kind, args_payload, deps, holds = encode_args(client, args, kwargs)
         num_returns = self._options.get("num_returns", 1)
         options = scheduling_options(self._options)
         if num_returns == "streaming":
@@ -59,7 +59,9 @@ class ActorMethod:
                 options,
                 return_task_id=True,
             )
-            return ObjectRefGenerator(task_id)
+            gen = ObjectRefGenerator(task_id)
+            gen._hold = holds or None
+            return gen
         return_ids = client.submit_actor_task(
             self._handle._actor_id,
             self._name,
@@ -70,6 +72,9 @@ class ActorMethod:
             options,
         )
         refs = [ObjectRef(r, _owned=True) for r in return_ids]
+        if holds:
+            for r in refs:
+                r._hold = holds
         return refs[0] if num_returns == 1 else refs
 
     def bind(self, *args, **kwargs):
@@ -143,7 +148,7 @@ class ActorClass:
         client = worker.get_client()
         opts = self._options
         fn_id = self._ensure_exported(client)
-        args_kind, args_payload, deps = encode_args(client, args, kwargs)
+        args_kind, args_payload, deps, holds = encode_args(client, args, kwargs)
         resources = canonical_resources(opts, is_actor=True)
         options = scheduling_options(opts)
         process_runtime_env(client, opts, options)
@@ -156,7 +161,12 @@ class ActorClass:
         actor_id, ready_id = client.create_actor(
             fn_id, args_kind, args_payload, deps, resources, options
         )
-        return ActorHandle(ActorID(actor_id.binary()), ObjectRef(ready_id))
+        ready_ref = ObjectRef(ready_id)
+        # spilled creation args are hub-pinned for the actor's lifetime;
+        # the twins on the ready ref let ownership GC reclaim them once
+        # both the handle's ready ref is gone and the actor is dead
+        ready_ref._hold = holds or None
+        return ActorHandle(ActorID(actor_id.binary()), ready_ref)
 
     def __call__(self, *a, **k):
         raise TypeError(

@@ -16,10 +16,15 @@ from ._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owned", "_shared", "__weakref__")
+    __slots__ = ("_id", "_owned", "_shared", "_hold", "__weakref__")
 
     def __init__(self, object_id: ObjectID, *, _owned: bool = False):
         self._id = object_id
+        # strong refs this ref keeps alive: owned twins of args the
+        # submitter spilled to the object store — when the caller drops
+        # its last return ref, the twins die and ownership GC frees the
+        # spilled args (the hub defers while the task is in flight)
+        self._hold = None
         # Ownership GC (simplified form of the reference's
         # ReferenceCounter, reference_count.h:43): a ref created by this
         # process's own put()/task submission is "owned"; when the LAST

@@ -58,10 +58,16 @@ def encode_args(client, args: tuple, kwargs: dict):
 
     Mirrors the reference's arg handling: small args inline with the task
     spec, large args become owned objects passed by reference
-    (python/ray/_raylet.pyx prepare_args)."""
+    (python/ray/_raylet.pyx prepare_args). Returns
+    (args_kind, payload, deps, holds): `holds` are owned twin refs for
+    the spilled objects — the caller attaches them to the task's return
+    refs so spilled args are freed when the call's results are dropped
+    (the hub pins them while the task is in flight), instead of leaking
+    one shm segment per call."""
     import numpy as np
 
     deps: List[bytes] = []
+    holds: List[ObjectRef] = []
 
     def spill(v):
         if isinstance(v, ObjectRef):
@@ -73,9 +79,12 @@ def encode_args(client, args: tuple, kwargs: dict):
         elif isinstance(v, (bytes, bytearray)) and len(v) > INLINE_THRESHOLD:
             big = True
         if big:
-            ref = ObjectRef(client.put_value(v))
-            deps.append(ref._id.binary())
-            return ref
+            oid = client.put_value(v)
+            deps.append(oid.binary())
+            holds.append(ObjectRef(oid, _owned=True))
+            # the pickled copy is a plain (non-owned) ref; the owned
+            # twin above stays unpickled so ownership GC can fire
+            return ObjectRef(oid)
         return v
 
     args = tuple(spill(a) for a in args)
@@ -84,8 +93,9 @@ def encode_args(client, args: tuple, kwargs: dict):
     if len(blob) > INLINE_THRESHOLD:
         oid = client.put_value((args, kwargs))
         deps.append(oid.binary())
-        return "ref", oid.binary(), deps
-    return "inline", blob, deps
+        holds.append(ObjectRef(oid, _owned=True))
+        return "ref", oid.binary(), deps, holds
+    return "inline", blob, deps, holds
 
 
 def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
@@ -263,7 +273,7 @@ class RemoteFunction:
 
         client = worker.get_client()
         fn_id = self._ensure_exported(client)
-        args_kind, args_payload, deps = encode_args(client, args, kwargs)
+        args_kind, args_payload, deps, holds = encode_args(client, args, kwargs)
         num_returns = opts.get("num_returns", 1)
         resources = canonical_resources(opts, is_actor=False)
         options = scheduling_options(opts)
@@ -284,12 +294,17 @@ class RemoteFunction:
                 fn_id, args_kind, args_payload, deps, 0, resources, options,
                 return_task_id=True,
             )
-            return ObjectRefGenerator(task_id)
+            gen = ObjectRefGenerator(task_id)
+            gen._hold = holds or None
+            return gen
         options.setdefault("max_retries", opts.get("max_retries", 3))
         return_ids = client.submit_task(
             fn_id, args_kind, args_payload, deps, num_returns, resources, options
         )
         refs = [ObjectRef(r, _owned=True) for r in return_ids]
+        if holds:
+            for r in refs:
+                r._hold = holds
         if num_returns == 1:
             return refs[0]
         return refs

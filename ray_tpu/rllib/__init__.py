@@ -14,6 +14,13 @@ from .env_runner import SingleAgentEnvRunner
 from .dqn import DQN, DQNConfig
 from .impala import IMPALA, IMPALAConfig, vtrace
 from .marwil import MARWIL, MARWILConfig
+from .multi_agent import (
+    MultiAgentAlgorithm,
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentEpisode,
+    make_multi_agent,
+)
 from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .ppo import PPOConfig
 from .sac import SAC, SACConfig
@@ -33,6 +40,11 @@ __all__ = [
     "MARWIL",
     "MARWILConfig",
     "MLPSpec",
+    "MultiAgentAlgorithm",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentEpisode",
+    "make_multi_agent",
     "PPOConfig",
     "SAC",
     "SACConfig",

@@ -32,10 +32,17 @@ class Algorithm:
             ray_tpu.init(ignore_reinit_error=True)
         self.config = config
 
+        env = config.env
+        env_config = getattr(config, "env_config", None)
+        if env_config and callable(env) and not isinstance(env, str):
+            # close the env_config over the creator — the runner calls
+            # creators with no arguments
+            creator, cfg = env, dict(env_config)
+            env = lambda: creator(cfg)  # noqa: E731
         runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
         self.env_runners = [
             runner_cls.remote(
-                config.env,
+                env,
                 config.num_envs_per_env_runner,
                 config.seed + 1000 * i,
                 config.rollout_fragment_length,

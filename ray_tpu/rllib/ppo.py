@@ -39,10 +39,28 @@ class PPOConfig:
     grad_clip: float = 0.5
     hiddens: Tuple[int, ...] = (64, 64)
     seed: int = 0
+    # multi-agent (reference: algorithm_config.multi_agent()):
+    # policies = module ids; policy_mapping_fn(agent_id, episode) -> id
+    policies: Optional[set] = None
+    policy_mapping_fn: Optional[Callable] = None
+    env_config: Optional[dict] = None
 
     # -- fluent builder (reference parity) --
-    def environment(self, env) -> "PPOConfig":
+    def environment(self, env, *, env_config=None) -> "PPOConfig":
         self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None) -> "PPOConfig":
+        """Enable multi-agent training (reference:
+        algorithm_config.py multi_agent): `policies` is the set of
+        module ids, `policy_mapping_fn(agent_id, episode)` routes each
+        agent to one of them."""
+        if policies is not None:
+            self.policies = set(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None,
@@ -70,6 +88,10 @@ class PPOConfig:
         return self
 
     def build_algo(self):
+        if self.policies or self.policy_mapping_fn:
+            from .multi_agent import MultiAgentAlgorithm
+
+            return MultiAgentAlgorithm(self)
         from .algorithm import Algorithm
 
         return Algorithm(self)
