@@ -18,6 +18,7 @@ Run: python bench_core.py [--quick]
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -301,6 +302,44 @@ def main() -> None:
     report("placement_group_create_removal", timeit(pg_churn, warmup=0), "pg/s")
 
     ray_tpu.shutdown()
+
+    # ---- client-mode object plane (no reference baseline: the
+    # reference's client microbenchmarks aren't in BASELINE.md; the row
+    # documents the chunk-streaming path's throughput)
+    import subprocess
+
+    ctx = ray_tpu.init(num_cpus=2, max_workers=2, _tcp_hub=True)
+    script = f"""
+import sys; sys.path.insert(0, {json.dumps(os.path.dirname(os.path.abspath(__file__)))})
+import time
+import numpy as np
+import ray_tpu
+ray_tpu.init(address={json.dumps(ctx.address_info["address"])})
+big = np.random.randint(0, 256, (64 * 1024 * 1024,), dtype=np.uint8)
+ray_tpu.free([ray_tpu.put(big)])  # warm the path
+n = {2 if QUICK else 8}
+t0 = time.perf_counter()
+for _ in range(n):
+    ray_tpu.free([ray_tpu.put(big)])
+dt = time.perf_counter() - t0
+print("RATE", n * big.nbytes / (1024 ** 3) / dt)
+ray_tpu.shutdown()
+"""
+    try:
+        out = subprocess.run(
+            [_sys.executable, "-c", script], capture_output=True,
+            text=True, timeout=300,
+        )
+        rate = next(
+            float(line.split()[1]) for line in out.stdout.splitlines()
+            if line.startswith("RATE")
+        )
+        report("client_put_gigabytes", rate, "GiB/s")
+    except Exception as e:  # noqa: BLE001
+        print(f"client_put_gigabytes failed: {e}", file=_sys.stderr)
+    finally:
+        ray_tpu.shutdown()
+
     ratios = [r["vs_baseline"] for r in RESULTS if r["vs_baseline"]]
     geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
     print(json.dumps({

@@ -66,8 +66,10 @@ class CoreClient:
         self._seen_fns: Dict[str, Any] = {}
         self.task_queue: "queue.Queue" = queue.Queue()
         self.cancelled_tasks: set = set()  # task_ids to drop at dequeue
-        # client mode (ray_tpu.init(address=...)): no shared shm with the
-        # cluster — all puts travel inline through the hub connection
+        # client mode (ray_tpu.init(address=...)): no shared shm with
+        # the cluster — small puts travel inline through the hub
+        # connection, large ones chunk-stream into the head-node store
+        # (encode_value / _fetch_segment_chunked)
         self.inline_only = False
         # pubsub: channel -> callback(data); callbacks run on the reader
         # thread, so keep them light (print/enqueue)
@@ -263,19 +265,46 @@ class CoreClient:
                 self._obj_cache[oid.binary()] = obj
         return oid
 
+    # client-mode puts above this size stream to the hub in chunks and
+    # land in the HEAD node's shm store as ordinary VAL_SHM objects
+    # (reference: util/client/server/dataservicer.py chunked PutObject);
+    # below it they ride inline through the connection as before
+    CLIENT_CHUNK_THRESHOLD = 4 * 1024 * 1024
+    FETCH_CHUNK = 8 * 1024 * 1024
+
     def encode_value(self, oid: ObjectID, obj: Any) -> Tuple[str, Any, int]:
         """Encode a value for transport: inline bytes or shm segment name."""
         from .serialization import dumps_oob
 
         header, buffers = dumps_oob(obj)
         nbytes = len(header) + sum(b.raw().nbytes for b in buffers)
-        if nbytes < INLINE_THRESHOLD or self.inline_only:
+        if nbytes < INLINE_THRESHOLD or (
+            self.inline_only and nbytes < self.CLIENT_CHUNK_THRESHOLD
+        ):
             if buffers:
                 blob = dumps_inline((header, [b.raw().tobytes() for b in buffers]))
             else:
                 blob = dumps_inline((header, []))
             return P.VAL_INLINE, blob, nbytes
         name = oid.hex()
+        if self.inline_only:
+            # chunk-stream the segment bytes to the hub; the last chunk
+            # makes the object ready cluster-side (the duplicate PUT the
+            # caller sends afterwards is a no-op: _object_ready ignores
+            # already-ready objects)
+            from .object_store import iter_segment_chunks
+
+            total, chunks = iter_segment_chunks(
+                header, [b.raw() for b in buffers]
+            )
+            sent = 0
+            for piece in chunks:
+                sent += len(piece)
+                self.send(P.PUT_CHUNK, {
+                    "object_id": oid.binary(), "name": name,
+                    "data": piece, "last": sent >= total,
+                })
+            return P.VAL_SHM, name, nbytes
         self.store.put_raw(name, header, [b.raw() for b in buffers])
         return P.VAL_SHM, name, nbytes
 
@@ -290,21 +319,66 @@ class CoreClient:
                 return self.store.get(payload)
             except FileNotFoundError:
                 # segment lives on another node: pull it through the hub
-                # (reference: object manager pull, ownership directory)
-                reply = self.request(P.FETCH_OBJECT, {"object_id": oid_bytes})
-                if reply.get("data") is None:
-                    with self._obj_cache_lock:
-                        self._known_ready.pop(oid_bytes, None)
-                    raise exceptions.ObjectLostError(
-                        f"object {oid_bytes.hex()} unavailable: "
-                        f"{reply.get('error')}"
-                    ) from None
-                self.store.write_segment(payload, reply["data"])
+                # (reference: object manager pull, ownership directory).
+                # Shm-less clients stream it in chunks so a multi-GB get
+                # never materializes twice in hub memory.
+                if self.inline_only:
+                    self._fetch_segment_chunked(oid_bytes, payload)
+                else:
+                    reply = self.request(
+                        P.FETCH_OBJECT, {"object_id": oid_bytes}
+                    )
+                    if reply.get("data") is None:
+                        with self._obj_cache_lock:
+                            self._known_ready.pop(oid_bytes, None)
+                        raise exceptions.ObjectLostError(
+                            f"object {oid_bytes.hex()} unavailable: "
+                            f"{reply.get('error')}"
+                        ) from None
+                    self.store.write_segment(payload, reply["data"])
                 return self.store.get(payload)
         if kind == P.VAL_ERROR:
             err = loads_inline(payload)
             raise err
         raise ValueError(f"unknown value kind {kind}")
+
+    def _fetch_segment_chunked(self, oid_bytes: bytes, name: str) -> None:
+        """Pull a remote segment into the local scratch store in
+        FETCH_CHUNK slices (reference: dataservicer.py chunked
+        GetObject). Idempotent offset reads, so the retry-safe request
+        path applies per chunk."""
+        # pid AND thread id: two threads get()ing the same not-yet-local
+        # ref fetch independently; same bytes, last replace wins
+        tmp = (
+            self.store._path(name)
+            + f".fetch.{os.getpid()}.{threading.get_ident()}"
+        )
+        off, total = 0, None
+        try:
+            with open(tmp, "wb") as f:
+                while total is None or off < total:
+                    reply = self.request(P.FETCH_OBJECT, {
+                        "object_id": oid_bytes,
+                        "offset": off,
+                        "length": self.FETCH_CHUNK,
+                    })
+                    data = reply.get("data")
+                    if data is None or (not data and off < (total or 1)):
+                        with self._obj_cache_lock:
+                            self._known_ready.pop(oid_bytes, None)
+                        raise exceptions.ObjectLostError(
+                            f"object {oid_bytes.hex()} unavailable: "
+                            f"{reply.get('error')}"
+                        ) from None
+                    f.write(data)
+                    off += len(data)
+                    total = reply.get("total", off)
+            os.replace(tmp, self.store._path(name))
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
     def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
         out: Dict[bytes, Any] = {}

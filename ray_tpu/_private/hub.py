@@ -383,6 +383,8 @@ class Hub:
         self._timer_seq = itertools.count()
         self._fetch_seq = itertools.count()
         self._pending_fetches: Dict[int, Tuple[Any, int]] = {}
+        # in-progress chunked client puts: (conn id, name) -> open file
+        self._client_puts: Dict[Tuple[int, str], Any] = {}
         self._spawn_wants: Dict[str, int] = {}
         self.streams: Dict[bytes, StreamEntry] = {}
         self.subscribers: Dict[str, List[Any]] = {}  # channel -> conns
@@ -1013,6 +1015,8 @@ class Hub:
                 e.spilled = False
             if not e.spilled:
                 self._account_segment(p["object_id"], e)
+        offset = p.get("offset")
+        length = p.get("length")
         if node.agent_conn is None:
             path = os.path.join(
                 self.spill_dir if e.spilled else
@@ -1021,23 +1025,77 @@ class Hub:
             )
             try:
                 with open(path, "rb") as f:
-                    data = f.read()
+                    if offset is None:
+                        data, total = f.read(), None
+                    else:
+                        # chunked streaming for shm-less clients
+                        # (reference: dataservicer.py chunked GetObject)
+                        total = os.fstat(f.fileno()).st_size
+                        f.seek(offset)
+                        data = f.read(length)
             except OSError as err:
                 self._reply(conn, p["req_id"], data=None, error=str(err))
                 return
-            self._reply(conn, p["req_id"], data=data)
+            self._reply(conn, p["req_id"], data=data, total=total)
             return
         fid = next(self._fetch_seq)
         self._pending_fetches[fid] = (conn, p["req_id"], node.node_id)
         self._send(node.agent_conn, P.OBJ_READ,
-                   {"fetch_id": fid, "name": e.payload})
+                   {"fetch_id": fid, "name": e.payload,
+                    "offset": offset, "length": length})
 
     def _on_obj_read_reply(self, conn, p):
         waiter = self._pending_fetches.pop(p["fetch_id"], None)
         if waiter is None:
             return
         self._reply(waiter[0], waiter[1], data=p.get("data"),
-                    error=p.get("error"))
+                    error=p.get("error"), total=p.get("total"))
+
+    # ----- chunked client puts (shm-less client -> head-node store;
+    # reference: util/client/server/dataservicer.py PutObject chunking)
+    def _on_put_chunk(self, conn, p):
+        name = p["name"]
+        key = (id(conn), name)
+        objdir = os.path.join(self.session_dir, "objects")
+        tmp = os.path.join(objdir, f".client.{key[0]:x}.{name}")
+        st = self._client_puts.get(key)
+        try:
+            if st is None:
+                os.makedirs(objdir, exist_ok=True)
+                st = self._client_puts[key] = open(tmp, "wb")
+            if isinstance(st, tuple):  # stream already failed
+                raise OSError(st[1])
+            st.write(p["data"])
+        except OSError as err:
+            # poison the stream: later chunks are dropped and the LAST
+            # chunk publishes an error object so the producer's
+            # follow-up get/consume surfaces the failure instead of a
+            # truncated segment
+            if not isinstance(st, tuple):
+                try:
+                    if st is not None:
+                        st.close()
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            self._client_puts[key] = ("failed", str(err))
+            if p.get("last"):
+                self._client_puts.pop(key, None)
+                self._object_ready(
+                    p["object_id"], P.VAL_ERROR,
+                    dumps_inline(OSError(
+                        f"client put of {name} failed hub-side: {err}"
+                    )), 0,
+                )
+            return
+        if p.get("last"):
+            self._client_puts.pop(key, None)
+            size = st.tell()
+            st.close()
+            os.replace(tmp, os.path.join(objdir, name))
+            self._object_ready(
+                p["object_id"], P.VAL_SHM, name, size, node_id="node0"
+            )
 
     def _fail_fetches_for_node(self, node_id: str):
         """A fetch whose producer node died would otherwise hang its
@@ -2057,6 +2115,15 @@ class Hub:
         if conn in self.client_conns:
             self.client_conns.remove(conn)
         self._outbox.pop(conn, None)
+        cid_ = id(conn)
+        for key in [k for k in self._client_puts if k[0] == cid_]:
+            f = self._client_puts.pop(key)
+            try:
+                name = f.name
+                f.close()
+                os.unlink(name)
+            except OSError:
+                pass
         for subs in self.subscribers.values():
             if conn in subs:
                 subs.remove(conn)

@@ -128,10 +128,17 @@ class NodeAgent:
             if not os.path.exists(path):
                 path = os.path.join(self.spill_dir, p["name"])  # spilled
             try:
+                total = None
                 with open(path, "rb") as f:
-                    data = f.read()
+                    if p.get("offset") is None:
+                        data = f.read()
+                    else:
+                        total = os.fstat(f.fileno()).st_size
+                        f.seek(p["offset"])
+                        data = f.read(p.get("length"))
                 self._send(P.OBJ_READ_REPLY,
-                           {"fetch_id": p["fetch_id"], "data": data})
+                           {"fetch_id": p["fetch_id"], "data": data,
+                            "total": total})
             except OSError as err:
                 self._send(
                     P.OBJ_READ_REPLY,

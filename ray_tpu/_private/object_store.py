@@ -51,6 +51,66 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+def _segment_layout(header: bytes, raws: List[memoryview]):
+    """Compute (total_size, [(offset, part), ...]) for a segment.
+    Parts are either bytes (metadata words) or the raw buffers."""
+    parts: List[Tuple[int, Any]] = [
+        (0, struct.pack("<Q", len(header))),
+        (8, header),
+    ]
+    pos = 8 + len(header)
+    for r in raws:
+        pos = _align(pos)
+        parts.append((pos, struct.pack("<Q", r.nbytes)))
+        pos = _align(pos + 8)
+        parts.append((pos, r))
+        pos += r.nbytes
+    return _align(pos), parts
+
+
+def iter_segment_chunks(header: bytes, raws: List[memoryview],
+                        chunk: int = 8 * 1024 * 1024):
+    """Yield the byte stream of a segment (exactly the put_raw wire
+    layout) in ~chunk-sized pieces without materializing the whole
+    segment — the transport for shm-less clients streaming a large put
+    to the hub (reference: util/client/server/dataservicer.py chunked
+    PutObject). Returns (total_size, generator)."""
+    total, parts = _segment_layout(header, raws)
+    # every piece — padding included — funnels through the same
+    # accumulate-and-flush loop, so acc never exceeds chunk regardless
+    # of alignment gaps vs chunk size
+    pieces: List[Any] = []
+    pos = 0
+    for off, part in parts:
+        if off != pos:
+            pieces.append(b"\x00" * (off - pos))
+            pos = off
+        mv = part if isinstance(part, memoryview) else memoryview(part)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        pieces.append(mv)
+        pos += mv.nbytes
+    if pos != total:
+        pieces.append(b"\x00" * (total - pos))
+
+    def gen():
+        acc = bytearray()
+        for p in pieces:
+            mv = memoryview(p)
+            i, n = 0, mv.nbytes
+            while i < n:
+                take = min(chunk - len(acc), n - i)
+                acc += mv[i:i + take]
+                i += take
+                if len(acc) >= chunk:
+                    yield bytes(acc)
+                    acc = bytearray()
+        if acc:
+            yield bytes(acc)
+
+    return total, gen()
+
+
 class MappedSegment:
     """An open mmap of one object segment; kept alive while views exist.
 
@@ -124,20 +184,7 @@ class ShmObjectStore:
             return seg
 
     def _layout(self, header: bytes, raws: List[memoryview]):
-        """Compute (total_size, [(offset, part), ...]) for a segment.
-        Parts are either bytes (metadata words) or the raw buffers."""
-        parts: List[Tuple[int, Any]] = [
-            (0, struct.pack("<Q", len(header))),
-            (8, header),
-        ]
-        pos = 8 + len(header)
-        for r in raws:
-            pos = _align(pos)
-            parts.append((pos, struct.pack("<Q", r.nbytes)))
-            pos = _align(pos + 8)
-            parts.append((pos, r))
-            pos += r.nbytes
-        return _align(pos), parts
+        return _segment_layout(header, raws)
 
     def put(self, name: str, obj: Any) -> int:
         """Serialize obj into a new segment. Returns segment size."""

@@ -57,6 +57,12 @@ OBJ_UNLINK = "obj_unlink"          # hub -> agent: free a shm segment
 OBJ_SPILL = "obj_spill"            # hub -> agent: move a segment to disk
 OBJ_RESTORE = "obj_restore"        # hub -> agent: move it back to shm
 FETCH_OBJECT = "fetch_object"      # client -> hub: pull a remote segment
+                                   # (optional offset/length for chunked
+                                   # streaming to shm-less clients)
+PUT_CHUNK = "put_chunk"            # client -> hub: one slice of a large
+                                   # put streamed over the connection
+                                   # (reference: util/client/server/
+                                   # dataservicer.py chunked PutObject)
 
 # hub -> worker
 EXEC_TASK = "exec_task"
