@@ -77,6 +77,11 @@ class CoreClient:
         self._closed = False
         self.send(P.HELLO, {"role": role, "worker_id": worker_id,
                             "pid": os.getpid(), "node_id": self.node_id})
+        # shm frees anywhere in the cluster invalidate the local wait()
+        # readiness cache (otherwise a freed object reports ready here
+        # indefinitely; the follow-up get would raise ObjectLostError)
+        self.subscriptions["__obj_freed__"] = self._on_objs_freed
+        self.send(P.SUBSCRIBE, {"channel": "__obj_freed__"})
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="core-client-reader")
         self._reader.start()
 
@@ -167,6 +172,13 @@ class CoreClient:
                 if not fut.done():
                     fut.set_exception(ConnectionError("hub connection lost"))
             self.task_queue.put((P.KILL, {}))
+
+    def _on_objs_freed(self, oids) -> None:
+        """Runs on the reader thread (pubsub callback): drop freed ids
+        from the readiness cache."""
+        with self._obj_cache_lock:
+            for oid in oids:
+                self._known_ready.pop(oid, None)
 
     def _dispatch_inbound(self, msg_type, payload):
         if msg_type == P.REPLY:

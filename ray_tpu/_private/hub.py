@@ -109,6 +109,9 @@ class NodeEntry:
     agent_conn: Any = None  # None => head node (hub-local spawning)
     alive: bool = True
     spawning: int = 0
+    # how many of the in-flight spawns were requested FOR ACTOR wants —
+    # pooled-task spawns must not eat the actor quota for a round
+    spawning_actor: int = 0
     # shm object-store budget (reference: plasma eviction_policy.h LRU +
     # external_storage.py spilling): bytes of live segments vs the cap
     store_cap: float = 0.0  # 0 = unlimited
@@ -142,6 +145,7 @@ class WorkerEntry:
     proc: Any = None
     node_id: str = "node0"
     runtime_env_hash: str = ""  # workers only serve matching runtime envs
+    spawned_for_actor: bool = False  # purpose of the spawn (quota math)
     state: str = "starting"  # starting | idle | busy | actor | dead
     current_task: Optional[TaskSpec] = None
     actor_id: Optional[bytes] = None
@@ -572,6 +576,8 @@ class Hub:
             node = self.nodes.get(w.node_id)
             if node is not None:
                 node.spawning = max(0, node.spawning - 1)
+                if w.spawned_for_actor:
+                    node.spawning_actor = max(0, node.spawning_actor - 1)
             self._dispatch()
         elif p["role"] == "driver":
             self.driver_conn = conn
@@ -608,6 +614,8 @@ class Hub:
             node = self.nodes.get(w.node_id)
             if node is not None:
                 node.spawning = max(0, node.spawning - 1)
+                if w.spawned_for_actor:
+                    node.spawning_actor = max(0, node.spawning_actor - 1)
             sys.stderr.write(
                 f"[ray_tpu] worker {w.worker_id} on {w.node_id} exited with "
                 f"code {p.get('code')} before connecting\n"
@@ -928,9 +936,11 @@ class Hub:
         self._free_ids(p["object_ids"])
 
     def _free_ids(self, object_ids):
+        freed_shm = []
         for oid in object_ids:
             e = self.objects.pop(oid, None)
             if e and e.kind == P.VAL_SHM:
+                freed_shm.append(oid)
                 self._drop_segment_accounting(oid, e)
                 # unlink on EVERY node: cross-node fetches install copies
                 # under the same segment name on consumer hosts
@@ -946,6 +956,13 @@ class Hub:
                     if node.alive and node.agent_conn is not None:
                         self._send(node.agent_conn, P.OBJ_UNLINK,
                                    {"name": e.payload})
+        # clients cache wait()-readiness locally (_known_ready); shm
+        # frees invalidate those entries so a freed object stops
+        # reporting ready. Inline frees are deliberately not broadcast —
+        # they dominate free traffic (every small task return) and their
+        # values are usually already cached client-side.
+        if freed_shm and self.subscribers.get("__obj_freed__"):
+            self._publish("__obj_freed__", freed_shm)
 
     def _on_fetch_object(self, conn, p):
         """Cross-node shm fetch: the consumer's local store misses, so the
@@ -1465,15 +1482,16 @@ class Hub:
             if node is None or not node.alive:
                 continue
             n_actor = sum(1 for _, _, ia in wants if ia)
-            # in-flight spawns satisfy actor wants first (actors are
-            # exempt from the pooled cap but must not re-spawn on every
-            # dispatch event while their workers are still booting)
-            actor_quota = max(0, n_actor - node.spawning)
+            # in-flight ACTOR-purposed spawns satisfy actor wants (so a
+            # boot-storm doesn't respawn every dispatch round), and
+            # pooled-purposed spawns offset pooled wants — per-purpose
+            # counters so pooled spawns can't starve actor wants
+            actor_quota = max(0, n_actor - node.spawning_actor)
+            spawning_pooled = max(0, node.spawning - node.spawning_actor)
             budget = max(
                 0,
                 min(
-                    (len(wants) - n_actor)
-                    - max(0, node.spawning - n_actor),
+                    (len(wants) - n_actor) - spawning_pooled,
                     node.max_workers - self._node_worker_count(node_id),
                 ),
             )
@@ -1482,7 +1500,8 @@ class Hub:
                     if actor_quota > 0:
                         actor_quota -= 1
                         self._spawn_worker(node, runtime_env=renv,
-                                           renv_hash=renv_hash)
+                                           renv_hash=renv_hash,
+                                           for_actor=True)
                 elif budget > 0:
                     budget -= 1
                     self._spawn_worker(node, runtime_env=renv,
@@ -1683,17 +1702,19 @@ class Hub:
         return os.pathsep.join(dict.fromkeys(paths))
 
     def _spawn_worker(self, node: NodeEntry, runtime_env=None,
-                      renv_hash: str = ""):
+                      renv_hash: str = "", for_actor: bool = False):
         import json as _json
 
         wid = WorkerID.generate().hex()
         node.spawning += 1
+        if for_actor:
+            node.spawning_actor += 1
         renv_json = _json.dumps(runtime_env) if runtime_env else ""
         if node.agent_conn is not None:
             # remote host: the node agent forks the worker there
             self.workers[wid] = WorkerEntry(
                 worker_id=wid, state="starting", node_id=node.node_id,
-                runtime_env_hash=renv_hash,
+                runtime_env_hash=renv_hash, spawned_for_actor=for_actor,
             )
             env = dict(
                 self.worker_env,
@@ -1724,7 +1745,7 @@ class Hub:
         )
         self.workers[wid] = WorkerEntry(
             worker_id=wid, proc=proc, state="starting", node_id=node.node_id,
-            runtime_env_hash=renv_hash,
+            runtime_env_hash=renv_hash, spawned_for_actor=for_actor,
         )
 
     def _reap_workers(self):
@@ -1743,6 +1764,8 @@ class Hub:
             node = self.nodes.get(w.node_id)
             if node is not None:
                 node.spawning = max(0, node.spawning - 1)
+                if w.spawned_for_actor:
+                    node.spawning_actor = max(0, node.spawning_actor - 1)
             self.workers.pop(w.worker_id, None)
         if dead:
             self._dispatch()
@@ -2157,6 +2180,7 @@ class Hub:
         node.agent_conn = None
         node.avail = {}
         node.spawning = 0
+        node.spawning_actor = 0
         sys.stderr.write(f"[ray_tpu] node {node_id} died\n")
         self._fail_fetches_for_node(node_id)
         self._dispatch()
@@ -2455,9 +2479,12 @@ class Hub:
             self._commit_slice(entry, [n.node_id] * len(need), chunks)
             return
         # 2) one bundle per host, distinct hosts, each chunk contiguous
+        # (preferred over mixed packing: bundle ranks map 1:1 onto
+        # hosts, the layout multihost jobs expect)
         if len(topo_nodes) >= len(entry.bundles):
             plan: List[Tuple[NodeEntry, tuple]] = []
             used: Set[str] = set()
+            feasible = True
             for b, k in zip(entry.bundles, need):
                 found = None
                 for n in topo_nodes:
@@ -2472,14 +2499,51 @@ class Hub:
                         found = (n, tuple(path))
                         break
                 if found is None:
-                    return  # infeasible now; stays pending
+                    feasible = False
+                    break
                 used.add(found[0].node_id)
                 plan.append(found)
-            self._commit_slice(
-                entry,
-                [n.node_id for n, _ in plan],
-                [chunk for _, chunk in plan],
-            )
+            if feasible:
+                self._commit_slice(
+                    entry,
+                    [n.node_id for n, _ in plan],
+                    [chunk for _, chunk in plan],
+                )
+                return
+        # 3) mixed packing: k bundles per host, each bundle's chunk
+        # host-contiguous. Greedy largest-first over per-host planned
+        # copies of free chips/resources — places gangs that fragment
+        # past cases 1 and 2 (e.g. 3x2-chip bundles on one fragmented
+        # 8-chip host, or 4 bundles over 2 hosts).
+        order = sorted(range(len(need)), key=lambda i: -need[i])
+        planned_free = {n.node_id: set(n.free_tpu_chips) for n in topo_nodes}
+        planned_avail = {n.node_id: dict(n.avail) for n in topo_nodes}
+        mixed: List[Optional[Tuple[str, tuple]]] = [None] * len(need)
+        for idx in order:
+            b, k = entry.bundles[idx], need[idx]
+            for n in topo_nodes:
+                if not self._resources_fit(b, planned_avail[n.node_id]):
+                    continue
+                if k == 0:
+                    mixed[idx] = (n.node_id, ())
+                    self._acquire(b, planned_avail[n.node_id])
+                    break
+                path = _find_chip_path(
+                    n.chip_coords, planned_free[n.node_id], k
+                )
+                if path is None:
+                    continue
+                mixed[idx] = (n.node_id, tuple(path))
+                self._acquire(b, planned_avail[n.node_id])
+                planned_free[n.node_id].difference_update(path)
+                break
+            if mixed[idx] is None:
+                return  # infeasible now; stays pending
+        self._commit_slice(
+            entry,
+            [a[0] for a in mixed],
+            [a[1] for a in mixed],
+        )
 
     def _commit_slice(self, entry: PGEntry, assign: List[str],
                       chunks: List[tuple]):
@@ -2505,14 +2569,23 @@ class Hub:
                     if node is None:
                         continue
                     node.pg_reserved_chips.difference_update(chunk)
-                    # chips still pinned by a live worker return to the
-                    # free pool when that worker dies (see _worker_died)
-                    pinned = {
-                        c
-                        for w in self.workers.values()
-                        if w.node_id == nid and w.pinned_chips
-                        for c in w.pinned_chips
-                    }
+                    # chips pinned by IDLE pooled workers come back
+                    # immediately (kill the worker — its jax binding is
+                    # useless outside the removed PG); busy/actor
+                    # workers release theirs on death (see _worker_died)
+                    pinned = set()
+                    for w in list(self.workers.values()):
+                        if w.node_id != nid or not w.pinned_chips:
+                            continue
+                        if (
+                            w.state == "idle"
+                            and w.actor_id is None
+                            and set(w.pinned_chips) & set(chunk)
+                        ):
+                            self._kill_worker(w)
+                            self._worker_died(w)
+                            continue
+                        pinned.update(w.pinned_chips)
                     node.free_tpu_chips.update(set(chunk) - pinned)
         self._dispatch()
 

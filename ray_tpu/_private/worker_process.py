@@ -42,6 +42,7 @@ class WorkerRuntime:
         self.fn_cache: Dict[str, Any] = {}
         self.actor_instance: Any = None
         self.actor_id: Optional[bytes] = None
+        self.actor_restarted = False
         self.actor_pg: Optional[tuple] = None  # (pg_id, bundle_idx)
         self.pool: Optional[ThreadPoolExecutor] = None
         self.aio_loop: Optional[asyncio.AbstractEventLoop] = None
@@ -217,10 +218,10 @@ class WorkerRuntime:
     def exec_actor_create(self, p: dict):
         if p.get("tpu_chips"):
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in p["tpu_chips"])
-        if (p.get("options") or {}).get("_restarted"):
-            # the hub marks respawned incarnations so user __init__ can
-            # branch on was_current_actor_reconstructed
-            os.environ["RAY_TPU_ACTOR_RESTARTED"] = "1"
+        # the hub marks respawned incarnations so user __init__ can
+        # branch on was_current_actor_reconstructed; always assigned so
+        # a later actor on a reused worker never inherits the flag
+        self.actor_restarted = bool((p.get("options") or {}).get("_restarted"))
         from ..runtime_context import _current_pg
 
         pg = (p.get("options") or {}).get("placement_group")
@@ -364,8 +365,33 @@ def _setup_runtime_env(client, session_dir: str) -> None:
     renv = json.loads(renv_json)
     for k, v in (renv.get("env_vars") or {}).items():
         os.environ[k] = v
+    # conda was handled pre-connect in main() (execv re-entry)
     if renv.get("pip"):
         _materialize_pip_env(client, session_dir, renv["pip"])
+    for mod_uri in renv.get("py_modules") or ():
+        # reference: py_modules.py — one cached extract dir per content
+        # hash, prepended to sys.path (no chdir, unlike working_dir)
+        target = os.path.join(session_dir, "runtime_envs", f"pymod_{mod_uri}")
+        if not os.path.isdir(target):
+            blob = client.kv_get(f"__runtime_env_pkg__{mod_uri}".encode())
+            if blob is None:
+                raise RuntimeError(
+                    f"runtime env py_module {mod_uri} missing from KV"
+                )
+            import io
+            import zipfile
+
+            tmp = target + f".tmp.{os.getpid()}"
+            os.makedirs(tmp, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.replace(tmp, target)
+            except OSError:
+                import shutil
+
+                shutil.rmtree(tmp, ignore_errors=True)
+        sys.path.insert(0, target)
     uri = renv.get("working_dir_uri")
     if uri:
         import zipfile
@@ -390,6 +416,114 @@ def _setup_runtime_env(client, session_dir: str) -> None:
                 shutil.rmtree(tmp, ignore_errors=True)
         os.chdir(target)
         sys.path.insert(0, target)
+
+
+def _materialize_conda_env(spec: dict) -> None:
+    """Re-exec this worker inside a conda env (reference:
+    _private/runtime_env/conda.py — get_or_create_conda_env + the
+    context's python override). Named envs resolve directly; dict specs
+    materialize once per content hash under the conda root, guarded by
+    the same create-exclusive lock pattern as the pip cache. Requires a
+    conda/mamba/micromamba binary (RAY_TPU_CONDA_EXE, CONDA_EXE, or
+    PATH) — absent tooling fails loudly at task dispatch, matching the
+    reference's behavior when conda is not installed."""
+    import hashlib
+    import json as _json
+    import shutil
+    import subprocess
+    import time
+
+    if os.environ.get("RAY_TPU_IN_CONDA_ENV"):
+        return  # already re-exec'd inside the target env
+    exe = os.environ.get("RAY_TPU_CONDA_EXE") or os.environ.get("CONDA_EXE")
+    if not exe:
+        for cand in ("conda", "mamba", "micromamba"):
+            exe = shutil.which(cand)
+            if exe:
+                break
+    if not exe:
+        raise RuntimeError(
+            "runtime_env conda requires a conda/mamba/micromamba binary "
+            "(set RAY_TPU_CONDA_EXE or install one); none found on PATH"
+        )
+    if spec.get("name"):
+        # named env: resolve its prefix via conda itself
+        out = subprocess.run(
+            [exe, "env", "list", "--json"], capture_output=True, text=True,
+            timeout=60,
+        )
+        envs = _json.loads(out.stdout or "{}").get("envs", [])
+        prefix = next(
+            (e for e in envs if os.path.basename(e) == spec["name"]), None
+        )
+        if prefix is None:
+            raise RuntimeError(f"conda env {spec['name']!r} not found")
+    else:
+        blob = _json.dumps(spec["spec"], sort_keys=True).encode()
+        env_id = hashlib.sha1(blob).hexdigest()[:16]
+        root = os.environ.get(
+            "RAY_TPU_CONDA_ENV_ROOT",
+            os.path.join(os.path.expanduser("~"), ".ray_tpu_conda_envs"),
+        )
+        prefix = os.path.join(root, env_id)
+        done = os.path.join(prefix, ".create_done")
+        if not os.path.exists(done):
+            os.makedirs(root, exist_ok=True)
+            lock = os.path.join(root, f"{env_id}.lock")
+            deadline = time.monotonic() + 1800
+            acquired = False
+            while time.monotonic() < deadline:
+                try:
+                    fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.close(fd)
+                    acquired = True
+                    break
+                except FileExistsError:
+                    if os.path.exists(done):
+                        break
+                    time.sleep(0.5)
+            if acquired:
+                try:
+                    if not os.path.exists(done):
+                        spec_file = os.path.join(root, f"{env_id}.yml")
+                        with open(spec_file, "w") as f:
+                            _json.dump(spec["spec"], f)
+                        proc = subprocess.run(
+                            [exe, "env", "create", "--prefix", prefix,
+                             "--file", spec_file, "--json"],
+                            capture_output=True, text=True, timeout=1700,
+                        )
+                        if proc.returncode != 0:
+                            # a partial prefix poisons every retry
+                            # (conda refuses an existing non-empty dir)
+                            shutil.rmtree(prefix, ignore_errors=True)
+                            raise RuntimeError(
+                                f"conda env create failed:\n{proc.stderr}"
+                            )
+                        with open(done, "w") as f:
+                            f.write(env_id)
+                finally:
+                    try:
+                        os.unlink(lock)
+                    except OSError:
+                        pass
+            if not os.path.exists(done):
+                raise RuntimeError(
+                    f"conda env create did not complete for {env_id}"
+                )
+    env_python = os.path.join(prefix, "bin", "python")
+    if not os.path.exists(env_python):
+        raise RuntimeError(f"conda env at {prefix} has no python")
+    # the env's interpreter must also see ray_tpu itself
+    os.environ["RAY_TPU_IN_CONDA_ENV"] = prefix
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        dict.fromkeys(
+            [os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))]
+            + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        )
+    ).rstrip(os.pathsep)
+    os.execv(env_python, [env_python, "-m", "ray_tpu._private.worker_process"])
 
 
 def _materialize_pip_env(client, session_dir: str, spec: dict) -> None:
@@ -463,8 +597,13 @@ def _materialize_pip_env(client, session_dir: str, spec: dict) -> None:
                         with open(wpath, "wb") as f:
                             f.write(blob)
                         wheel_paths.append(wpath)
+                    # every wheel dir is a findable index so a shipped
+                    # wheel can satisfy another shipped wheel's
+                    # dependency; wheels-only installs are fully offline
+                    for wpath in wheel_paths:
+                        args += ["--find-links", os.path.dirname(wpath)]
                     if wheels and not spec.get("reqs"):
-                        args += ["--no-index"]  # fully offline: wheels only
+                        args += ["--no-index"]
                     args += list(spec.get("reqs") or [])
                     args += wheel_paths
                     proc = subprocess.run(
@@ -539,8 +678,30 @@ def main():
     hub_addr = os.environ["RAY_TPU_HUB_ADDR"]
     session_dir = os.environ["RAY_TPU_SESSION_DIR"]
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
+    # conda re-exec must happen BEFORE the hub connection exists: execv
+    # closes the socket (CLOEXEC) and the replacement process redoes
+    # HELLO — connecting first would surface as a spurious worker death.
+    # Materialization failures are RECORDED, not raised: the worker
+    # still connects and fails its tasks with the setup error
+    # (reference: RuntimeEnvSetupError delivered to the task), instead
+    # of dying pre-connect and triggering a respawn storm.
+    setup_error: Optional[Exception] = None
+    renv_json = os.environ.get("RAY_TPU_RUNTIME_ENV")
+    if renv_json:
+        import json as _json
+
+        conda_spec = _json.loads(renv_json).get("conda")
+        if conda_spec:
+            try:
+                _materialize_conda_env(conda_spec)  # may not return (execv)
+            except Exception as e:  # noqa: BLE001
+                setup_error = e
     client = CoreClient(hub_addr, session_dir, role="worker", worker_id=worker_id)
-    _setup_runtime_env(client, session_dir)
+    if setup_error is None:
+        try:
+            _setup_runtime_env(client, session_dir)
+        except Exception as e:  # noqa: BLE001
+            setup_error = e
     if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
         sys.stdout = _LogTee(client, sys.stdout, "stdout")
         sys.stderr = _LogTee(client, sys.stderr, "stderr")
@@ -573,6 +734,31 @@ def main():
                 payload["task_id"] in client.cancelled_tasks
             ):
                 rt.reply_cancelled(payload)
+            elif setup_error is not None and msg_type in (
+                P.EXEC_TASK, P.EXEC_ACTOR_TASK, P.EXEC_ACTOR_CREATE,
+            ):
+                # runtime env never materialized: every task fails with
+                # the setup error (reference: RuntimeEnvSetupError)
+                from ..exceptions import TaskError
+
+                err = TaskError(
+                    "runtime_env_setup",
+                    f"runtime env setup failed: {setup_error}",
+                    cause=setup_error,
+                )
+                blob = dumps_inline(err)
+                returns = [
+                    (oid, P.VAL_ERROR, blob, 0)
+                    for oid in payload.get("return_ids", [])
+                ]
+                if msg_type == P.EXEC_ACTOR_CREATE:
+                    client.send(P.ACTOR_READY, {
+                        "actor_id": payload["actor_id"], "error": blob,
+                    })
+                else:
+                    client.send(P.TASK_DONE, {
+                        "task_id": payload["task_id"], "returns": returns,
+                    })
             elif msg_type == P.EXEC_TASK:
                 rt.exec_task(payload)
             elif msg_type == P.EXEC_ACTOR_CREATE:

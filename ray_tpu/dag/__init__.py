@@ -7,15 +7,19 @@ CompiledDAG via dag.experimental_compile()).
 from .compiled_dag import CompiledDAG, CompiledDAGRef
 from .dag_node import (
     ClassMethodNode,
+    CollectiveOutputNode,
     DAGNode,
     FunctionNode,
     InputAttributeNode,
     InputNode,
     MultiOutputNode,
+    allreduce,
 )
 
 __all__ = [
     "ClassMethodNode",
+    "CollectiveOutputNode",
+    "allreduce",
     "CompiledDAG",
     "CompiledDAGRef",
     "DAGNode",

@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .dag_node import (
     ClassMethodNode,
+    CollectiveOutputNode,
     DAGNode,
     InputAttributeNode,
     InputNode,
@@ -44,11 +45,18 @@ from .dag_node import (
 )
 
 
-def _compiled_exec_loop(instance, method_name, arg_plan, out_descs, stop_desc):
+def _compiled_exec_loop(instance, method_name, arg_plan, out_descs, stop_desc,
+                        coll_plan=None):
     """Resident per-actor loop (reference: do_exec_tasks,
     compiled_dag_node.py:193). Runs inside the actor via __ray_call__:
     read inputs from ring channels, run the method, write outputs —
-    until the stop channel signals teardown."""
+    until the stop channel signals teardown.
+
+    coll_plan (reference: dag/collective_node.py executing over the
+    Communicator ABC) runs an allreduce INSIDE the loop: the method
+    output is exchanged with the group's peers over a full mesh of shm
+    channels and reduced locally, then the reduced array flows to
+    coll_plan["outs"]. Zero scheduler traffic per tick."""
     import numpy as np
 
     from ray_tpu.experimental.channel.shm_channel import ShmChannel
@@ -66,6 +74,17 @@ def _compiled_exec_loop(instance, method_name, arg_plan, out_descs, stop_desc):
     ]
     outs = [attach(d) for d in out_descs]
     stop = attach(stop_desc)
+    if coll_plan is not None:
+        coll_sends = [attach(d) for d in coll_plan["sends"]]
+        coll_recvs = [attach(d) for d in coll_plan["recvs"]]
+        coll_outs = [attach(d) for d in coll_plan["outs"]]
+        reduce_ops = {
+            "sum": lambda a, b: a + b,
+            "prod": lambda a, b: a * b,
+            "max": np.maximum,
+            "min": np.minimum,
+        }
+        coll_reduce = reduce_ops[coll_plan["op"]]
     method = getattr(instance, method_name)
     try:
         while True:
@@ -93,6 +112,37 @@ def _compiled_exec_loop(instance, method_name, arg_plan, out_descs, stop_desc):
             out = method(*args)
             for ch in outs:
                 ch.write(np.asarray(out))
+            if coll_plan is not None:
+                contrib = np.asarray(out)
+                # all ranks send first (ring capacity absorbs skew) ...
+                for ch in coll_sends:
+                    ch.write(contrib)
+                # ... then fold in GLOBAL rank order so every rank
+                # computes bit-identical floats (recvs arrive ordered by
+                # peer rank; own contribution slots in at coll_plan rank)
+                contribs = []
+                stopped = False
+                for slot, ch in enumerate(coll_recvs):
+                    if slot == coll_plan["rank"]:
+                        contribs.append(contrib)
+                    while True:
+                        if stop.try_read() is not None:
+                            stopped = True
+                            break
+                        try:
+                            contribs.append(ch.read(timeout_s=0.2))
+                            break
+                        except TimeoutError:
+                            continue
+                    if stopped:
+                        return "stopped"
+                if len(contribs) == len(coll_recvs):
+                    contribs.append(contrib)  # own rank is last
+                acc = contribs[0].copy()
+                for c in contribs[1:]:
+                    acc = coll_reduce(acc, c)
+                for ch in coll_outs:
+                    ch.write(acc)
     finally:
         for ch in chans.values():
             ch.close()
@@ -149,6 +199,8 @@ class CompiledDAG:
                 node, "_channel_spec", None
             ):
                 continue
+            if isinstance(node, CollectiveOutputNode) and node._channel_spec:
+                continue
             return False
         leaves = (
             list(self._root._bound_args)
@@ -156,7 +208,8 @@ class CompiledDAG:
             else [self._root]
         )
         return bool(self._inputs) and all(
-            isinstance(x, ClassMethodNode) for x in leaves
+            isinstance(x, (ClassMethodNode, CollectiveOutputNode))
+            for x in leaves
         )
 
     def _compile_channels(self) -> None:
@@ -188,6 +241,63 @@ class CompiledDAG:
         compute_nodes = [
             n for n in self._schedule if isinstance(n, ClassMethodNode)
         ]
+        # collective groups: a full mesh of peer channels per group
+        # (reference: collective_node.py binds a Communicator; here the
+        # "communicator" is the pre-allocated channel mesh). Keyed by
+        # parent node id -> per-actor exchange plan.
+        coll_nodes = [
+            n for n in self._schedule if isinstance(n, CollectiveOutputNode)
+        ]
+        self._coll_plans: Dict[int, dict] = {}
+        groups_done = set()
+        for cnode in coll_nodes:
+            gkey = (cnode._op, tuple(sorted(p._id for p in cnode._group)))
+            if gkey in groups_done:
+                continue
+            groups_done.add(gkey)
+            group = cnode._group
+            spec = cnode._channel_spec
+            for parent in group:
+                if parent._id in self._coll_plans:
+                    # one exec loop per actor runs ONE exchange per
+                    # tick; a parent in two groups (different op or
+                    # overlapping membership) would need two
+                    raise ValueError(
+                        "channel-compiled DAGs support one collective "
+                        "per participating node (node "
+                        f"{parent._method._name!r} is in two groups)"
+                    )
+            # mesh channels live in their own key namespace — a data
+            # edge between two group members (one parent feeding
+            # another) must NOT share a channel with the exchange
+            def mesh(src, dst):
+                key = ("mesh", src._id, dst._id)
+                if key not in self._edge_chans:
+                    self._edge_chans[key] = ShmChannel.create(
+                        shape=spec[0], dtype=spec[1], capacity=cap
+                    )
+                return self._edge_chans[key]
+
+            for i, src in enumerate(group):
+                for j, dst in enumerate(group):
+                    if i != j:
+                        mesh(src, dst)
+            for i, parent in enumerate(group):
+                self._coll_plans[parent._id] = {
+                    "op": cnode._op,
+                    "rank": i,
+                    "sends": [
+                        desc(mesh(parent, dst))
+                        for j, dst in enumerate(group) if j != i
+                    ],
+                    # recvs ordered by peer rank for the deterministic
+                    # global fold in the exec loop
+                    "recvs": [
+                        desc(mesh(src, parent))
+                        for j, src in enumerate(group) if j != i
+                    ],
+                    "outs": [],  # filled by the out-edge pass below
+                }
         for node in compute_nodes:
             actor = node._method._handle
             aid = actor._actor_id.binary()
@@ -223,9 +333,14 @@ class CompiledDAG:
             if isinstance(self._root, MultiOutputNode)
             else [self._root]
         )
+        for cnode in coll_nodes:
+            if cnode in leaves:
+                ch = edge(cnode, -1, cnode._channel_spec)  # -1 = driver
+                self._coll_plans[cnode._parent._id]["outs"].append(desc(ch))
         for node in compute_nodes:
             out_descs = []
             for key, ch in self._edge_chans.items():
+                # mesh keys are ("mesh", src, dst) — never match a node id
                 if key[0] == node._id:
                     out_descs.append(desc(ch))
             if node in leaves:
@@ -240,13 +355,14 @@ class CompiledDAG:
                     node._arg_plan,
                     out_descs,
                     desc(stop),
+                    self._coll_plans.get(node._id),
                 )
             )
         self._driver_out = [self._edge_chans[(leaf._id, -1)] for leaf in leaves]
         self._multi_output = isinstance(self._root, MultiOutputNode)
         self._input_edges = [
-            ch for (pid, _), ch in self._edge_chans.items()
-            if pid == self._inputs[0]._id
+            ch for key, ch in self._edge_chans.items()
+            if key[0] == self._inputs[0]._id
         ] if self._inputs else []
         self._seq_submit = itertools.count()
         self._seq_read = 0

@@ -176,3 +176,81 @@ class MultiOutputNode(DAGNode):
 
     def _apply(self, results, input_args, input_kwargs):
         return [results[n._id] for n in self._bound_args]
+
+
+class CollectiveOutputNode(DAGNode):
+    """One participant's reduced output of an in-DAG allreduce
+    (reference: dag/collective_node.py CollectiveOutputNode over the
+    Communicator ABC, experimental/channel/communicator.py:19).
+
+    Channel-compiled execution runs the reduction INSIDE the resident
+    exec loops: the group's actors exchange contributions over a full
+    mesh of pre-allocated shm ring channels and reduce locally — zero
+    scheduler round-trips per tick. (The TPU-side analogue of the
+    reference's NCCL allreduce node is a jitted psum over the mesh —
+    ray_tpu.parallel — this node is the host/DAG-plane counterpart.)
+    """
+
+    def __init__(self, parent: "ClassMethodNode", group: List["ClassMethodNode"],
+                 rank: int, op: str):
+        # every group member is a real dependency: the reduce needs all
+        # contributions, and the topo schedule must order them first
+        super().__init__(args=tuple(group))
+        self._parent = parent
+        self._group = group
+        self._rank = rank
+        self._op = op
+        self._channel_spec = getattr(parent, "_channel_spec", None)
+
+    def _apply(self, results, input_args, input_kwargs):
+        # legacy (non-channel) mode: resolve every participant's ref and
+        # reduce driver-side — semantics preserved without loops
+        import numpy as np
+
+        import ray_tpu
+
+        vals = [
+            np.asarray(v)
+            for v in ray_tpu.get([results[n._id] for n in self._group])
+        ]
+        acc = vals[0].copy()
+        for v in vals[1:]:
+            acc = _REDUCE_OPS[self._op](acc, v)
+        return ray_tpu.put(acc)
+
+
+_REDUCE_OPS = {
+    "sum": lambda a, b: a + b,
+    "prod": lambda a, b: a * b,
+    "max": lambda a, b: __import__("numpy").maximum(a, b),
+    "min": lambda a, b: __import__("numpy").minimum(a, b),
+}
+
+
+class _AllReduce:
+    """`allreduce.bind([n1, n2, ...])` — binds an allreduce across DAG
+    nodes living on distinct actors, returning one CollectiveOutputNode
+    per input (reference: ray.experimental.collective.allreduce)."""
+
+    @staticmethod
+    def bind(nodes: List["ClassMethodNode"], op: str = "sum"
+             ) -> List["CollectiveOutputNode"]:
+        if op not in _REDUCE_OPS:
+            raise ValueError(f"unsupported allreduce op {op!r}")
+        if len(nodes) < 2:
+            raise ValueError("allreduce needs at least two participants")
+        for n in nodes:
+            if not isinstance(n, ClassMethodNode):
+                raise ValueError(
+                    "allreduce participants must be actor-method nodes"
+                )
+        actors = {n._method._handle._actor_id.binary() for n in nodes}
+        if len(actors) != len(nodes):
+            raise ValueError("allreduce participants must be distinct actors")
+        return [
+            CollectiveOutputNode(n, list(nodes), i, op)
+            for i, n in enumerate(nodes)
+        ]
+
+
+allreduce = _AllReduce()

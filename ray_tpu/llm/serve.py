@@ -50,6 +50,7 @@ class LLMServer:
         from collections import OrderedDict
 
         self._engines: "OrderedDict[str, LlamaEngine]" = OrderedDict()
+        self._loading: set = set()  # adapter ids mid-cold-load (cap slots)
         self._engines[""] = self.engine
         self._engines_lock = threading.Lock()
         self._reporter = None
@@ -120,38 +121,46 @@ class LLMServer:
         with self._engines_lock:
             # HARD cap: when every loaded adapter is mid-generation and
             # the cap is reached, refuse — an unbounded engine pile-up
-            # (full KV cache each) OOMs the replica
+            # (full KV cache each) OOMs the replica. In-flight loads
+            # count via the _loading placeholder set, closing the
+            # check-then-act window (the load itself runs unlocked for
+            # seconds).
             busy = [
                 aid for aid in self._engines
                 if aid and self._engines[aid].num_active()
             ]
-            if len(busy) >= cap:
+            if len(busy) + len(self._loading) >= cap:
                 raise RuntimeError(
                     f"all {cap} adapter slots are busy; retry later "
                     "(max_adapters_per_replica)"
                 )
+            self._loading.add(adapter_id)
 
-        from ._internal.engine import LlamaEngine
-        from .lora import apply_lora, load_lora_adapter
+        try:
+            from ._internal.engine import LlamaEngine
+            from .lora import apply_lora, load_lora_adapter
 
-        base = lora["dynamic_lora_loading_path"]
-        path = (
-            base.format(adapter_id)
-            if "{}" in base
-            else os.path.join(base, adapter_id + ".npz")
-        )
-        folded = apply_lora(
-            self._base_params,
-            load_lora_adapter(path),
-            scale=float(lora.get("scale", 1.0)),
-        )
-        eng = LlamaEngine(
-            self._model_config,
-            folded,
-            max_batch=self.config.max_batch_size,
-            max_seq=self.config.max_seq_len,
-            **self.config.engine_kwargs,
-        )
+            base = lora["dynamic_lora_loading_path"]
+            path = (
+                base.format(adapter_id)
+                if "{}" in base
+                else os.path.join(base, adapter_id + ".npz")
+            )
+            folded = apply_lora(
+                self._base_params,
+                load_lora_adapter(path),
+                scale=float(lora.get("scale", 1.0)),
+            )
+            eng = LlamaEngine(
+                self._model_config,
+                folded,
+                max_batch=self.config.max_batch_size,
+                max_seq=self.config.max_seq_len,
+                **self.config.engine_kwargs,
+            )
+        finally:
+            with self._engines_lock:
+                self._loading.discard(adapter_id)
         with self._engines_lock:
             existing = self._engines.get(adapter_id)
             if existing is not None:  # lost a racing load of the same id
@@ -190,12 +199,18 @@ class LLMServer:
                     requeue.append(req)
                     continue
                 try:
-                    eng.add_request(req)
+                    ok = eng.add_request(req)
                 except Exception as e:
                     # a bad request (e.g. prompt >= max_seq) must fail
                     # its own caller, never the batching thread
                     if q is not None:
                         q.put(("error", e))
+                    continue
+                if ok is False:
+                    # no slot after all (has_capacity raced a concurrent
+                    # admit): retry next loop instead of dropping the
+                    # request on the floor
+                    requeue.append(req)
                     continue
                 admitted = True
                 # the first token arrives from step() once the chunked

@@ -256,12 +256,19 @@ def _bwd_call(x, w, targets, lse, g, block_n, block_v):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def fused_cross_entropy(x, w, targets, block_n: int = 128,
                         block_v: int = 512):
-    """Per-token losses (N,) f32 for logits = x @ w against targets."""
+    """Per-token losses (N,) f32 for logits = x @ w against targets.
+
+    Out-of-range targets are clamped into [0, V) to match the XLA
+    path's gather semantics (jnp.take_along_axis clamps under jit);
+    without the clamp the kernel's one-hot match would silently miss
+    and return lse instead of a real loss."""
+    targets = jnp.clip(targets, 0, w.shape[1] - 1)
     lse, tgt = _fwd_call(x, w, targets, block_n, _pick_block(w.shape[1], block_v))
     return lse - tgt
 
 
 def _vjp_fwd(x, w, targets, block_n, block_v):
+    targets = jnp.clip(targets, 0, w.shape[1] - 1)  # match XLA gather clamp
     bv = _pick_block(w.shape[1], block_v)
     lse, tgt = _fwd_call(x, w, targets, block_n, bv)
     return lse - tgt, (x, w, targets, lse)

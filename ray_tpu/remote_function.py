@@ -221,12 +221,77 @@ def process_runtime_env(client, opts: Dict[str, Any], out: Dict[str, Any]) -> No
                 reqs.append(r)
         processed["pip"] = {"reqs": sorted(reqs),
                             "wheels": dict(sorted(wheels.items()))}
-    unknown = set(renv) - {"env_vars", "working_dir", "pip", "uv"}
+    mods = renv.get("py_modules")
+    if mods:
+        # reference: _private/runtime_env/py_modules.py — each entry is
+        # a local package dir (zipped once per content hash into the
+        # cluster KV, extracted onto sys.path node-side) or a built
+        # wheel (rides the pip/offline-wheel machinery)
+        mod_uris: list = []
+        memo = _uploaded_env_uris(client)
+        for m in mods:
+            path = os.path.expanduser(str(m))
+            if os.path.isfile(path) and path.endswith(".whl"):
+                with open(path, "rb") as f:
+                    blob = f.read()
+                uri = hashlib.sha1(blob).hexdigest()[:16]
+                if uri not in memo:
+                    client.kv_put(f"__runtime_env_whl__{uri}".encode(),
+                                  blob, overwrite=True)
+                    memo.add(uri)
+                pip_spec = processed.setdefault(
+                    "pip", {"reqs": [], "wheels": {}}
+                )
+                pip_spec["wheels"][uri] = os.path.basename(path)
+            elif os.path.isdir(path):
+                buf = io.BytesIO()
+                base = os.path.basename(path.rstrip(os.sep))
+                with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+                    for root, _, files in os.walk(path):
+                        for fname in sorted(files):
+                            full = os.path.join(root, fname)
+                            rel = os.path.join(
+                                base, os.path.relpath(full, path)
+                            )
+                            zf.write(full, rel)
+                blob = buf.getvalue()
+                uri = hashlib.sha1(blob).hexdigest()[:16]
+                client.kv_put(f"__runtime_env_pkg__{uri}".encode(), blob,
+                              overwrite=True)
+                mod_uris.append(uri)
+            else:
+                raise ValueError(
+                    f"runtime_env py_modules entry {m!r} must be a local "
+                    "package directory or a built wheel"
+                )
+        if mod_uris:
+            processed["py_modules"] = mod_uris
+    conda = renv.get("conda")
+    if conda is not None:
+        # reference: _private/runtime_env/conda.py — a named env or an
+        # environment.yml-style dict; materialization happens node-side
+        # (hash-cached, file-locked) and the worker re-execs inside the
+        # env's interpreter
+        if isinstance(conda, str):
+            processed["conda"] = {"name": conda}
+        elif isinstance(conda, dict):
+            processed["conda"] = {
+                "spec": json.loads(json.dumps(conda, sort_keys=True))
+            }
+        else:
+            raise ValueError(
+                "runtime_env conda must be an env name or an "
+                "environment dict"
+            )
+    unknown = set(renv) - {
+        "env_vars", "working_dir", "pip", "uv", "py_modules", "conda",
+    }
     if unknown:
         raise ValueError(
             f"unsupported runtime_env keys {sorted(unknown)} (supported: "
-            "env_vars, working_dir, pip, uv; conda/container need "
-            "tooling this environment does not ship)"
+            "env_vars, working_dir, pip, uv, py_modules, conda; "
+            "'container' needs a container runtime this environment "
+            "does not ship)"
         )
     out["runtime_env"] = processed
     out["runtime_env_hash"] = hashlib.sha1(

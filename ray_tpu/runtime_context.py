@@ -64,7 +64,15 @@ class RuntimeContext:
 
     @property
     def was_current_actor_reconstructed(self) -> bool:
-        return os.environ.get("RAY_TPU_ACTOR_RESTARTED", "") == "1"
+        # per-runtime flag, not os.environ: a process-wide env var would
+        # leak one actor's restart marker to later actors hosted by the
+        # same worker
+        from ._private import worker
+
+        runtime = getattr(worker, "_worker_runtime", None)
+        if runtime is not None:
+            return bool(getattr(runtime, "actor_restarted", False))
+        return False
 
 
 _context = RuntimeContext()

@@ -24,6 +24,69 @@ from ..dag.dag_node import DAGNode, FunctionNode, InputNode
 _storage_base: Optional[str] = None
 
 
+class EventListener:
+    """External-event source for durable workflows (reference:
+    python/ray/workflow/event_listener.py EventListener ABC).
+
+    `poll_for_event()` blocks until the event occurs and returns its
+    payload; `event_checkpointed(event)` runs AFTER the payload is
+    durably persisted — the commit hook where e.g. a queue message is
+    acked, giving exactly-once delivery INTO the workflow (the payload
+    checkpoint is consulted before any re-poll on resume)."""
+
+    def poll_for_event(self) -> Any:
+        raise NotImplementedError
+
+    def event_checkpointed(self, event: Any) -> None:
+        pass
+
+
+class EventNode(DAGNode):
+    """A durable wait-for-event step (reference: workflow.wait_for_event
+    building a WaitForEvent step). Resume semantics: a checkpointed
+    payload short-circuits the poll entirely."""
+
+    def __init__(self, listener_cls, args, kwargs):
+        super().__init__()
+        if not (isinstance(listener_cls, type)
+                and issubclass(listener_cls, EventListener)):
+            raise TypeError(
+                "wait_for_event expects an EventListener subclass"
+            )
+        self._listener_cls = listener_cls
+        self._listener_args = args
+        self._listener_kwargs = kwargs
+
+    def make_listener(self) -> EventListener:
+        return self._listener_cls(
+            *self._listener_args, **self._listener_kwargs
+        )
+
+    def _apply(self, results, input_args, input_kwargs):
+        import cloudpickle
+
+        import ray_tpu
+
+        blob = cloudpickle.dumps(
+            (self._listener_cls, self._listener_args, self._listener_kwargs)
+        )
+
+        @ray_tpu.remote
+        def _poll_for_event(b):
+            import cloudpickle as _cp
+
+            cls, a, kw = _cp.loads(b)
+            return cls(*a, **kw).poll_for_event()
+
+        return _poll_for_event.remote(blob)
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> EventNode:
+    """Bind an external-event wait into a workflow DAG (reference:
+    workflow/api.py wait_for_event)."""
+    return EventNode(listener_cls, args, kwargs)
+
+
 def init(storage: str) -> None:
     """Set the workflow storage root (reference: workflow.init)."""
     global _storage_base
@@ -45,6 +108,8 @@ def _node_key(node: DAGNode, index: int) -> str:
     name = ""
     if isinstance(node, FunctionNode):
         name = getattr(node._remote_fn, "__name__", "fn")
+    elif isinstance(node, EventNode):
+        name = f"event_{node._listener_cls.__name__}"
     return f"{index:04d}_{name}"
 
 
@@ -119,6 +184,15 @@ def run(dag: DAGNode, *, workflow_id: str, args: Any = None) -> Any:
             cloudpickle.dump(value, f)
         os.replace(tmp, path)  # durable BEFORE dependents may run
 
+    def _ack_event(node: "EventNode", path: str, value: Any) -> None:
+        """Commit hook AFTER the durable checkpoint (reference:
+        event_listener.event_checkpointed — ack the source). The
+        .acked marker makes the hook itself resumable: a crash
+        between persist and ack re-runs ONLY the hook."""
+        node.make_listener().event_checkpointed(value)
+        with open(path + ".acked", "w") as f:
+            f.write("1")
+
     from collections import deque as _deque
 
     worklist: "_deque" = _deque()  # nodes whose deps are all in `results`
@@ -133,7 +207,7 @@ def run(dag: DAGNode, *, workflow_id: str, args: Any = None) -> Any:
         if isinstance(node, InputNode):
             _finish(node, args)
             return
-        if not isinstance(node, FunctionNode):
+        if not isinstance(node, (FunctionNode, EventNode)):
             # passthrough nodes (input attributes, multi-output)
             _finish(node, node._apply(results, (args,), {}))
             return
@@ -141,8 +215,17 @@ def run(dag: DAGNode, *, workflow_id: str, args: Any = None) -> Any:
             results_dir, _node_key(node, index_of[node._id]) + ".pkl"
         )
         if os.path.exists(path):
+            # exactly-once: a checkpointed event payload (or task
+            # result) is NEVER re-polled/re-run on resume
             with open(path, "rb") as f:
-                _finish(node, cloudpickle.load(f))
+                value = cloudpickle.load(f)
+            if isinstance(node, EventNode) and not os.path.exists(
+                path + ".acked"
+            ):
+                # crashed between persist and the commit hook: re-run
+                # the hook (at-least-once ack, exactly-once payload)
+                _ack_event(node, path, value)
+            _finish(node, value)
             return
         in_flight[node._apply(results, (args,), {})] = node
 
@@ -167,6 +250,15 @@ def run(dag: DAGNode, *, workflow_id: str, args: Any = None) -> Any:
             node = in_flight.pop(done[0])
             value = ray_tpu.get(done[0])
             _persist(node, value)
+            if isinstance(node, EventNode):
+                _ack_event(
+                    node,
+                    os.path.join(
+                        results_dir,
+                        _node_key(node, index_of[node._id]) + ".pkl",
+                    ),
+                    value,
+                )
             _finish(node, value)
             _drain()
     except Exception:

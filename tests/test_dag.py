@@ -109,3 +109,110 @@ def test_shm_channel_shape_check():
             ch.write(np.zeros((3,), dtype=np.float32))
     finally:
         ch.close(unlink=True)
+
+
+# ----------------------------------------------------- collective nodes
+def test_compiled_dag_allreduce_zero_roundtrips(ray_start_4_cpus):
+    """In-DAG allreduce (reference: dag/collective_node.py over the
+    Communicator ABC): two actors each transform the input, the
+    compiled loops exchange + reduce over the pre-allocated channel
+    mesh, and the driver reads identical reduced results from both —
+    with ZERO scheduler tasks per tick."""
+    import numpy as np
+
+    from ray_tpu.dag import InputNode, MultiOutputNode, allreduce
+
+    @ray_tpu.remote
+    class Scale:
+        def __init__(self, k):
+            self.k = k
+
+        def run(self, x):
+            return x * self.k
+
+    a, b = Scale.remote(2.0), Scale.remote(3.0)
+    with InputNode() as inp:
+        na = a.run.bind(inp).with_shm_channel((4,))
+        nb = b.run.bind(inp).with_shm_channel((4,))
+        ra, rb = allreduce.bind([na, nb], op="sum")
+        dag = MultiOutputNode([ra, rb])
+    compiled = dag.experimental_compile(max_inflight_executions=4)
+    assert compiled._channel_mode
+
+    # warm tick
+    out = compiled.execute(np.ones(4, np.float32)).get(timeout=30)
+    np.testing.assert_allclose(out[0], np.full(4, 5.0))
+    np.testing.assert_allclose(out[1], np.full(4, 5.0))
+
+    def n_tasks():
+        return len(ray_tpu._private.worker.get_client().list_state("tasks"))
+
+    before = n_tasks()
+    refs = [compiled.execute(np.full(4, float(i), np.float32)) for i in range(6)]
+    outs = [r.get(timeout=30) for r in refs]
+    assert n_tasks() == before, "allreduce ticks must not submit tasks"
+    for i, (x, y) in enumerate(outs):
+        np.testing.assert_allclose(x, np.full(4, 5.0 * i))
+        np.testing.assert_allclose(y, x)  # bit-identical across ranks
+    compiled.teardown()
+
+
+def test_compiled_dag_allreduce_ops_and_legacy(ray_start_4_cpus):
+    import numpy as np
+
+    from ray_tpu.dag import InputNode, MultiOutputNode, allreduce
+
+    @ray_tpu.remote
+    class Add:
+        def __init__(self, k):
+            self.k = k
+
+        def run(self, x):
+            return x + self.k
+
+    a, b = Add.remote(1.0), Add.remote(10.0)
+    with InputNode() as inp:
+        na = a.run.bind(inp).with_shm_channel((2,))
+        nb = b.run.bind(inp).with_shm_channel((2,))
+        ra, rb = allreduce.bind([na, nb], op="max")
+        dag = MultiOutputNode([ra, rb])
+    compiled = dag.experimental_compile()
+    out = compiled.execute(np.zeros(2, np.float32)).get(timeout=30)
+    np.testing.assert_allclose(out[0], np.full(2, 10.0))
+    compiled.teardown()
+
+    # legacy (non-channel) mode reduces driver-side with identical
+    # semantics
+    with InputNode() as inp:
+        na = a.run.bind(inp)
+        nb = b.run.bind(inp)
+        ra, rb = allreduce.bind([na, nb], op="sum")
+        dag = MultiOutputNode([ra, rb])
+    compiled = dag.experimental_compile()
+    assert not compiled._channel_mode
+    ref = compiled.execute(np.zeros(2, np.float32))
+    vals = ref.get(timeout=30)
+    np.testing.assert_allclose(vals[0], np.full(2, 11.0))
+    np.testing.assert_allclose(vals[1], vals[0])
+
+
+def test_allreduce_bind_validation(ray_start_4_cpus):
+    import pytest as _pytest
+
+    from ray_tpu.dag import InputNode, allreduce
+
+    @ray_tpu.remote
+    class A:
+        def run(self, x):
+            return x
+
+    a = A.remote()
+    with InputNode() as inp:
+        n1 = a.run.bind(inp)
+        n2 = a.run.bind(inp)
+        with _pytest.raises(ValueError, match="distinct actors"):
+            allreduce.bind([n1, n2])
+        with _pytest.raises(ValueError, match="at least two"):
+            allreduce.bind([n1])
+        with _pytest.raises(ValueError, match="unsupported allreduce op"):
+            allreduce.bind([n1, n2], op="xor")

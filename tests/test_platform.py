@@ -140,12 +140,82 @@ def test_runtime_env_working_dir(ray_start_regular, tmp_path):
 
 
 def test_runtime_env_rejects_unsupported(ray_start_regular):
-    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["x"]}})
+    @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
     def f():
         return 1
 
     with pytest.raises(ValueError):
         f.remote()
+
+
+def test_runtime_env_conda_requires_tooling(ray_start_regular, monkeypatch):
+    """conda specs are accepted and materialize node-side (reference:
+    _private/runtime_env/conda.py); without any conda binary the worker
+    fails the task loudly instead of silently ignoring the env."""
+    monkeypatch.delenv("CONDA_EXE", raising=False)
+
+    @ray_tpu.remote(runtime_env={"conda": {"dependencies": ["pip"]}})
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="conda"):
+        ray_tpu.get(f.remote(), timeout=60)
+
+    # invalid spec types still reject at submit time
+    @ray_tpu.remote(runtime_env={"conda": 42})
+    def g():
+        return 1
+
+    with pytest.raises(ValueError, match="conda"):
+        g.remote()
+
+
+def test_runtime_env_py_modules_dir(ray_start_regular, tmp_path):
+    """py_modules (reference: _private/runtime_env/py_modules.py): a
+    local package dir ships by content hash and lands on the worker's
+    sys.path; a task WITHOUT the env must not see it (env-hash worker
+    isolation)."""
+    pkg = tmp_path / "rtpu_mod_demo"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("FLAVOR = 'from_py_modules'\n")
+    (pkg / "extra.py").write_text("def val():\n    return 41 + 1\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(pkg)]})
+    def with_env():
+        import rtpu_mod_demo
+        from rtpu_mod_demo import extra
+
+        return rtpu_mod_demo.FLAVOR, extra.val()
+
+    assert ray_tpu.get(with_env.remote(), timeout=120) == (
+        "from_py_modules", 42,
+    )
+
+    @ray_tpu.remote
+    def without_env():
+        try:
+            import rtpu_mod_demo  # noqa: F401
+
+            return "visible"
+        except ImportError:
+            return "isolated"
+
+    assert ray_tpu.get(without_env.remote(), timeout=60) == "isolated"
+
+
+def test_runtime_env_py_modules_wheel(ray_start_regular, tmp_path):
+    """A built wheel in py_modules installs through the offline pip
+    machinery (reference: py_modules.py pip-installing wheel URIs)."""
+    whl = _build_test_wheel(tmp_path, name="rtpu_pymod_whl",
+                            value="'wheel_via_py_modules'")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(whl)]})
+    def f():
+        import rtpu_pymod_whl
+
+        return rtpu_pymod_whl.VALUE
+
+    assert ray_tpu.get(f.remote(), timeout=240) == "wheel_via_py_modules"
 
 
 def _build_test_wheel(tmp_path, name="rtpu_demo_pkg", version="1.0",
@@ -523,3 +593,70 @@ def test_dashboard_serves_html_index(ray_start_regular):
         assert "/api/cluster_status" in html
     finally:
         d.stop()
+
+
+def test_workflow_events_exactly_once(ray_start_regular, tmp_path):
+    """wait_for_event (reference: workflow/event_listener.py): the
+    workflow dies mid-wait, resumes after the event fires, and the
+    checkpointed payload is never re-polled — exactly-once delivery
+    even when a LATER node crashes after the event checkpoint."""
+    from ray_tpu import workflow
+    from ray_tpu.dag import InputNode
+
+    workflow.init(str(tmp_path / "wf"))
+    evt_file = tmp_path / "the_event"
+    polls = tmp_path / "polls"
+    acks = tmp_path / "acks"
+
+    class FileEvent(workflow.EventListener):
+        def __init__(self, path, polls_path, acks_path):
+            self.path = path
+            self.polls_path = polls_path
+            self.acks_path = acks_path
+
+        def poll_for_event(self):
+            import time as _t
+
+            deadline = _t.monotonic() + 5
+            while _t.monotonic() < deadline:
+                if os.path.exists(self.path):
+                    open(self.polls_path, "a").write("p")
+                    return open(self.path).read()
+                _t.sleep(0.1)
+            raise TimeoutError("event never fired")
+
+        def event_checkpointed(self, event):
+            open(self.acks_path, "a").write("a")
+
+    @ray_tpu.remote
+    def consume(payload, x):
+        if not os.path.exists(tmp_path / "late_ok"):
+            open(tmp_path / "late_ok", "w").close()
+            raise RuntimeError("crash after event checkpoint")
+        return f"{payload}:{x}"
+
+    with InputNode() as inp:
+        ev = workflow.wait_for_event(
+            FileEvent, str(evt_file), str(polls), str(acks)
+        )
+        dag = consume.bind(ev, inp)
+
+    # 1) dies mid-wait (event absent -> listener times out)
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf_ev", args=7)
+    assert workflow.get_status("wf_ev") == "FAILED"
+    assert not polls.exists()
+
+    # 2) the event fires; resume polls ONCE, checkpoints, acks — then
+    # the downstream node crashes AFTER the checkpoint
+    evt_file.write_text("hello")
+    with pytest.raises(Exception):
+        workflow.resume("wf_ev", dag, args=7)
+    assert polls.read_text() == "p"
+    assert acks.read_text() == "a"
+
+    # 3) final resume: event NOT re-polled, downstream completes
+    out = workflow.resume("wf_ev", dag, args=7)
+    assert out == "hello:7"
+    assert polls.read_text() == "p"  # still exactly one poll
+    assert workflow.get_status("wf_ev") == "SUCCEEDED"

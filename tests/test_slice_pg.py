@@ -226,3 +226,49 @@ def test_slice_chips_return_after_worker_death(slice_cluster):
     )
     assert got == sorted(_pg_entry(pg)["bundle_chips"][0])
     remove_placement_group(pg)
+
+
+def test_slice_mixed_layout_fragmented_host(slice_cluster):
+    """Mixed packing (case 3): several bundles share one host when the
+    host's free chips are fragmented — no single path covers the whole
+    gang (case 1) and there are fewer hosts than bundles (case 2).
+    Layout: carve 1x8 into {0,1} {2,3} {4,5} {6,7} with holes at {2,3}
+    and ask for three 2-chip bundles."""
+    import time
+
+    edge = placement_group([{"TPU": 2}], strategy="SLICE")
+    assert edge.wait(10)
+    hole = placement_group([{"TPU": 2}], strategy="SLICE")
+    assert hole.wait(10)
+    hole_chips = _pg_entry(hole)["bundle_chips"][0]
+    assert len(hole_chips) == 2
+    # free the edge allocation: the hole now sits MID-line, free chips
+    # split into runs of 2 and 4 — no contiguous 6-path exists
+    remove_placement_group(edge)
+    time.sleep(0.2)
+
+    pg = placement_group([{"TPU": 2}, {"TPU": 2}, {"TPU": 2}],
+                         strategy="SLICE")
+    assert pg.wait(10), "mixed packing must place 3x2 around the hole"
+    entry = _pg_entry(pg)
+    chips = entry["bundle_chips"]
+    assert [len(c) for c in chips] == [2, 2, 2]
+    flat = [c for chunk in chips for c in chunk]
+    assert len(set(flat)) == 6 and not (set(flat) & set(hole_chips))
+    for chunk in chips:
+        assert _is_connected(chunk, _coords_1x8)
+    remove_placement_group(pg)
+    remove_placement_group(hole)
+    time.sleep(0.2)
+
+
+def test_slice_mixed_layout_prefers_per_host_ranks(slice_cluster):
+    """When one bundle per host IS feasible it stays preferred; mixed
+    packing only kicks in past it (here: single host, 2 bundles whose
+    total fits contiguously -> case 1, adjacent chunks)."""
+    pg = placement_group([{"TPU": 2}, {"TPU": 2}], strategy="SLICE")
+    assert pg.wait(10)
+    chips = _pg_entry(pg)["bundle_chips"]
+    flat = [c for chunk in chips for c in chunk]
+    assert _is_connected(flat, _coords_1x8)  # one contiguous 4-path
+    remove_placement_group(pg)
