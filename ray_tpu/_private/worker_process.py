@@ -756,6 +756,12 @@ def main():
                         "actor_id": payload["actor_id"], "error": blob,
                     })
                 else:
+                    if (payload.get("options") or {}).get("streaming"):
+                        # generator callers wait on the STREAM, not the
+                        # (empty) return ids
+                        client.send(P.STREAM_END, {
+                            "task_id": payload["task_id"], "error": blob,
+                        })
                     client.send(P.TASK_DONE, {
                         "task_id": payload["task_id"], "returns": returns,
                     })

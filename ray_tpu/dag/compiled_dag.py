@@ -75,16 +75,12 @@ def _compiled_exec_loop(instance, method_name, arg_plan, out_descs, stop_desc,
     outs = [attach(d) for d in out_descs]
     stop = attach(stop_desc)
     if coll_plan is not None:
+        from ray_tpu.dag.dag_node import _REDUCE_OPS
+
         coll_sends = [attach(d) for d in coll_plan["sends"]]
         coll_recvs = [attach(d) for d in coll_plan["recvs"]]
         coll_outs = [attach(d) for d in coll_plan["outs"]]
-        reduce_ops = {
-            "sum": lambda a, b: a + b,
-            "prod": lambda a, b: a * b,
-            "max": np.maximum,
-            "min": np.minimum,
-        }
-        coll_reduce = reduce_ops[coll_plan["op"]]
+        coll_reduce = _REDUCE_OPS[coll_plan["op"]]
     method = getattr(instance, method_name)
     try:
         while True:

@@ -72,6 +72,20 @@ class Dashboard:
         async def timeline(request):
             return web.json_response(self._client().list_state("timeline"))
 
+        async def data_stats(request):
+            import json as _json
+
+            client = self._client()
+            out = []
+            for key in sorted(client.kv_keys(b"__data_stats__"))[-20:]:
+                blob = client.kv_get(key)
+                if blob:
+                    try:
+                        out.append(_json.loads(blob))
+                    except ValueError:
+                        pass
+            return web.json_response(out)
+
         async def metrics(request):
             from ray_tpu.util.metrics import prometheus_text
 
@@ -121,6 +135,7 @@ class Dashboard:
         app.router.add_get("/", index)
         app.router.add_get("/api/cluster_status", cluster_status)
         app.router.add_get("/api/timeline", timeline)
+        app.router.add_get("/api/data_stats", data_stats)
         app.router.add_get("/api/jobs", jobs_list)
         app.router.add_post("/api/jobs", jobs_submit)
         app.router.add_get("/api/jobs/{job_id}", job_status)
@@ -134,6 +149,8 @@ class Dashboard:
         self._loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self.host, self.port)
         self._loop.run_until_complete(site.start())
+        if self.port == 0:  # ephemeral bind: report the real port
+            self.port = site._server.sockets[0].getsockname()[1]
         self._started.set()
         self._loop.run_forever()
 

@@ -26,6 +26,7 @@ from .dataset import (
     read_numpy,
     read_parquet,
     read_text,
+    read_tfrecords,
     read_webdataset,
 )
 from .datasource import Datasource, ReadTask
@@ -37,7 +38,8 @@ __all__ = [
     "GroupedData", "Max", "Mean", "Min", "ReadTask", "Std", "Sum",
     "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
     "read_binary_files", "read_csv", "read_datasource", "read_images",
-    "read_json", "read_numpy", "read_parquet", "read_text", "read_webdataset",
+    "read_json", "read_numpy", "read_parquet", "read_text", "read_tfrecords",
+    "read_webdataset",
 ]
 
 from ray_tpu._private import usage as _usage

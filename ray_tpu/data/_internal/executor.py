@@ -295,7 +295,37 @@ class StreamingExecutor:
                 stat = {"name": stage.name, "wall_s": 0.0, "blocks": 0}
                 self.stage_stats.append(stat)
                 stream = self._timed(stream, stat, _time)
-        return stream
+        return self._publish_stats_on_drain(stream)
+
+    def _publish_stats_on_drain(self, stream: Iterator[Any]) -> Iterator[Any]:
+        """When the pipeline drains, snapshot per-op stats into the
+        cluster KV so the dashboard's data view can render them
+        (reference: the dashboard's data section reads
+        DatasetStats via the stats actor)."""
+        yield from stream
+        try:
+            import json as _json
+            import time as _time
+
+            from ray_tpu._private import worker
+
+            client = worker._client
+            if client is None:
+                return
+            snap = _json.dumps({
+                "finished_at": _time.time(),
+                "stages": self.stage_stats,
+            }).encode()
+            client.kv_put(
+                f"__data_stats__{_time.time():.6f}".encode(), snap,
+                overwrite=True,
+            )
+            # bound the ring: keep the newest 50 snapshots
+            keys = sorted(client.kv_keys(b"__data_stats__"))
+            for k in keys[:-50]:
+                client.kv_del(k)
+        except Exception:
+            pass  # stats publishing must never fail a data job
 
     @staticmethod
     def _timed(stream: Iterator[Any], stat: dict, _time) -> Iterator[Any]:

@@ -390,6 +390,23 @@ class Dataset:
         return from_items(train), from_items(test)
 
     # ------------------------------------------------------------ write
+    def write_tfrecords(self, path: str) -> None:
+        """One TFRecord shard per block; rows encode as tf.train.Example
+        (reference: Dataset.write_tfrecords)."""
+        import os
+
+        import ray_tpu
+
+        from ._internal import tfrecords as tfr
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._block_refs()):
+            rows = BlockAccessor.for_block(ray_tpu.get(ref)).iter_rows()
+            tfr.write_records(
+                f"{path}/part-{i:05d}.tfrecords",
+                (tfr.encode_example(r) for r in rows),
+            )
+
     def write_parquet(self, path: str) -> None:
         import pyarrow.parquet as pq
         import os
@@ -612,6 +629,30 @@ def read_binary_files(paths, *, parallelism: int = -1, **_kw) -> Dataset:
     from .datasource import BinaryDatasource
 
     return read_datasource(BinaryDatasource(paths), parallelism=parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1,
+                   verify_crc: bool = False, raw: bool = False,
+                   **_kw) -> Dataset:
+    """TFRecord files -> one row per record (reference:
+    data/_internal/datasource/tfrecords_datasource.py). Records parse
+    as tf.train.Example protos into one column per feature (native
+    varint+CRC framing and proto codec — no TensorFlow dependency);
+    raw=True skips proto decoding and yields {"data": bytes}."""
+    from ._internal import tfrecords as tfr
+    from .datasource import FileBasedDatasource
+
+    class TFRecordDatasource(FileBasedDatasource):
+        def _read_file(self, path: str) -> Block:
+            rows = []
+            for rec in tfr.read_records(path, verify_crc=verify_crc):
+                if raw:
+                    rows.append({"data": rec})
+                else:
+                    rows.append(tfr.decode_example(rec))
+            return rows
+
+    return read_datasource(TFRecordDatasource(paths), parallelism=parallelism)
 
 
 def read_numpy(paths, *, parallelism: int = -1, **_kw) -> Dataset:

@@ -256,8 +256,10 @@ def process_runtime_env(client, opts: Dict[str, Any], out: Dict[str, Any]) -> No
                             zf.write(full, rel)
                 blob = buf.getvalue()
                 uri = hashlib.sha1(blob).hexdigest()[:16]
-                client.kv_put(f"__runtime_env_pkg__{uri}".encode(), blob,
-                              overwrite=True)
+                if uri not in memo:  # upload once per client per content
+                    client.kv_put(f"__runtime_env_pkg__{uri}".encode(),
+                                  blob, overwrite=True)
+                    memo.add(uri)
                 mod_uris.append(uri)
             else:
                 raise ValueError(

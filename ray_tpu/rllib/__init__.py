@@ -8,6 +8,13 @@ PPO (sync batch) + IMPALA (async actor-learner with V-trace, §2.5).
 from .algorithm import Algorithm
 from .appo import APPO, APPOConfig
 from .bc import BC, BCConfig
+from .connectors import (
+    ConnectorPipelineV2,
+    ConnectorV2,
+    FlattenObservations,
+    FrameStackObservations,
+    NormalizeObservations,
+)
 from .core import MLPSpec, forward, init_mlp_module, sample_actions
 from .cql import CQL, CQLConfig
 from .env_runner import SingleAgentEnvRunner
@@ -27,6 +34,11 @@ from .sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm",
+    "ConnectorPipelineV2",
+    "ConnectorV2",
+    "FlattenObservations",
+    "FrameStackObservations",
+    "NormalizeObservations",
     "APPO",
     "APPOConfig",
     "BC",

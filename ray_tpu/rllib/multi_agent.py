@@ -133,11 +133,14 @@ def make_multi_agent(env_name_or_creator) -> type:
 class _AgentTrack:
     """Per-agent trajectory inside one MultiAgentEpisode fragment."""
 
-    __slots__ = ("obs", "actions", "rewards", "logp", "values",
+    __slots__ = ("obs", "proc_obs", "actions", "rewards", "logp", "values",
                  "terminated", "truncated", "ep_return")
 
     def __init__(self):
         self.obs: List[np.ndarray] = []
+        # what the MODULE saw (post env→module connectors) — the
+        # learner must train on these, not the raw env obs
+        self.proc_obs: List[np.ndarray] = []
         self.actions: List[int] = []
         self.rewards: List[float] = []
         self.logp: List[float] = []
@@ -182,8 +185,12 @@ class MultiAgentEpisode:
             self._track(a).obs.append(np.asarray(o, np.float32).reshape(-1))
         self.agents_to_act = list(obs.keys())
 
-    def add_action(self, agent_id: str, action: int, logp: float, value: float):
+    def add_action(self, agent_id: str, action: int, logp: float,
+                   value: float, proc_obs: Optional[np.ndarray] = None):
         t = self.tracks[agent_id]
+        if proc_obs is None:
+            proc_obs = t.obs[len(t.actions)]
+        t.proc_obs.append(np.asarray(proc_obs, np.float32))
         t.actions.append(int(action))
         t.logp.append(float(logp))
         t.values.append(float(value))
@@ -225,13 +232,14 @@ class MultiAgentEpisode:
                 continue
             final_obs = t.obs[n] if len(t.obs) > n else None
             seq = {
-                "obs": np.stack(t.obs[:n]),
+                "obs": np.stack(t.proc_obs[:n]),
                 "actions": np.asarray(t.actions, np.int64),
                 "rewards": np.asarray(t.rewards, np.float32),
                 "logp": np.asarray(t.logp, np.float32),
                 "values": np.asarray(t.values, np.float32),
                 "terminated": t.terminated,
                 "final_obs": final_obs,
+                "agent_id": a,
             }
             out.setdefault(self.module_for(a), []).append(seq)
         return out
@@ -271,6 +279,7 @@ class MultiAgentEnvRunner:
         num_envs: int = 1,
         seed: Optional[int] = None,
         rollout_fragment_length: int = 128,
+        env_to_module_connector: Optional[Callable] = None,
     ):
         if isinstance(env_creator, str):
             raise ValueError(
@@ -288,6 +297,18 @@ class MultiAgentEnvRunner:
         self.episodes: List[Optional[MultiAgentEpisode]] = [None] * num_envs
         self.completed_returns: List[float] = []
         self._needs_reset = True
+        # per-module env→module connector pipelines (reference:
+        # config.env_to_module_connector building ConnectorV2 stacks)
+        self._conn_builder = env_to_module_connector
+        self._conns: Dict[str, Any] = {}
+
+    def _connector(self, module_id: str):
+        if self._conn_builder is None:
+            return None
+        conn = self._conns.get(module_id)
+        if conn is None:
+            conn = self._conns[module_id] = self._conn_builder()
+        return conn
 
     # ---- space discovery (driver builds module specs from this)
     def module_specs(self) -> Dict[str, Tuple[int, int]]:
@@ -296,6 +317,9 @@ class MultiAgentEnvRunner:
         for a in env.possible_agents:
             m = self._mapping(a, None)
             dim = int(np.prod(env.get_observation_space(a).shape))
+            conn = self._connector(m)
+            if conn is not None:
+                dim = int(conn.output_dim(dim))
             n_act = int(env.get_action_space(a).n)
             prev = specs.get(m)
             if prev is not None and prev != (dim, n_act):
@@ -313,6 +337,25 @@ class MultiAgentEnvRunner:
         ep.add_env_reset(obs, infos)
         self.episodes[i] = ep
         return ep
+
+    def _emit_sequences(self, env_i: int, ep: MultiAgentEpisode,
+                        sequences: Dict[str, List[dict]]) -> None:
+        """Collect a (finished or cut) episode's sequences, running
+        bootstrap obs through the connectors in peek mode (state must
+        not advance — the same obs re-enters the pipeline as the next
+        fragment's first inference input)."""
+        for mid, seqs in ep.extract_sequences().items():
+            conn = self._connector(mid)
+            if conn is not None:
+                for s in seqs:
+                    if s["final_obs"] is not None:
+                        s["final_obs"] = conn(
+                            {"obs": np.asarray(s["final_obs"])[None]},
+                            keys=[(env_i, s["agent_id"])],
+                            module_id=mid,
+                            peek=True,
+                        )["obs"][0]
+            sequences.setdefault(mid, []).extend(seqs)
 
     def sample(self, params_by_module: Dict[str, Any], rng_seed: int
                ) -> Dict[str, Any]:
@@ -343,6 +386,13 @@ class MultiAgentEnvRunner:
             ]
             for mid, items in by_module.items():
                 obs_batch = np.stack([o for _, _, o in items])
+                conn = self._connector(mid)
+                if conn is not None:
+                    obs_batch = conn(
+                        {"obs": obs_batch},
+                        keys=[(i, a) for i, a, _ in items],
+                        module_id=mid,
+                    )["obs"]
                 key, sub = jax.random.split(key)
                 acts, logp, vals = sample_actions(
                     params_by_module[mid], obs_batch, sub
@@ -352,7 +402,8 @@ class MultiAgentEnvRunner:
                 vals = np.asarray(vals)
                 for j, (i, a, _) in enumerate(items):
                     self.episodes[i].add_action(
-                        a, acts[j], logp[j], vals[j]
+                        a, acts[j], logp[j], vals[j],
+                        proc_obs=obs_batch[j],
                     )
                     actions_for_env[i][a] = int(acts[j])
             for i, ep in enumerate(self.episodes):
@@ -365,13 +416,16 @@ class MultiAgentEnvRunner:
                 ep.add_env_step(obs, rew, term, trunc, infos)
                 if ep.is_done:
                     self.completed_returns.append(ep.total_return())
-                    for mid, seqs in ep.extract_sequences().items():
-                        sequences.setdefault(mid, []).extend(seqs)
+                    self._emit_sequences(i, ep, sequences)
+                    if self._conns:
+                        done_keys = [(i, a) for a in ep.tracks]
+                        for conn in self._conns.values():
+                            conn.drop(done_keys)
                     self._reset_env(i)
         # fragment cut: emit partial sequences, carry live episodes over
+        # (connector state persists — the episodes continue)
         for i, ep in enumerate(self.episodes):
-            for mid, seqs in ep.extract_sequences().items():
-                sequences.setdefault(mid, []).extend(seqs)
+            self._emit_sequences(i, ep, sequences)
             self.episodes[i] = ep.cut()
         return {
             "sequences": sequences,
@@ -535,6 +589,7 @@ class MultiAgentAlgorithm:
                 config.num_envs_per_env_runner,
                 config.seed + 1000 * i,
                 config.rollout_fragment_length,
+                getattr(config, "env_to_module_connector", None),
             )
             for i in range(config.num_env_runners)
         ]
@@ -606,6 +661,12 @@ class MultiAgentAlgorithm:
             flat = _gae_flat(
                 seqs, boot, self.config.gamma, self.config.lambda_
             )
+            learner_conn = getattr(self.config, "learner_connector", None)
+            if learner_conn is not None:
+                # learner-side ConnectorV2 stage (reference:
+                # connectors/learner/) — transforms the flat batch
+                # before it enters the jitted update
+                flat = learner_conn(flat, module_id=mid)
             n = len(flat["actions"])
             a = flat["advantages"]
             flat["advantages"] = (a - a.mean()) / (a.std() + 1e-8)

@@ -44,6 +44,10 @@ class PPOConfig:
     policies: Optional[set] = None
     policy_mapping_fn: Optional[Callable] = None
     env_config: Optional[dict] = None
+    # ConnectorV2 pipelines (reference: config.env_to_module_connector /
+    # learner connector): builders called per module on the runner
+    env_to_module_connector: Optional[Callable] = None
+    learner_connector: Optional[Callable] = None
 
     # -- fluent builder (reference parity) --
     def environment(self, env, *, env_config=None) -> "PPOConfig":
@@ -64,13 +68,16 @@ class PPOConfig:
         return self
 
     def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None,
-                    rollout_fragment_length=None) -> "PPOConfig":
+                    rollout_fragment_length=None,
+                    env_to_module_connector=None) -> "PPOConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_env_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
         return self
 
     def training(self, **kwargs) -> "PPOConfig":

@@ -29,6 +29,7 @@ __all__ = [
     "get_deployment_handle",
     "get_multiplexed_model_id",
     "multiplexed",
+    "grpc_port",
     "run",
     "shutdown",
     "start",
@@ -136,13 +137,17 @@ def _get_or_start_controller():
 
 
 _proxy = None
+_grpc_proxy = None
+_grpc_port = None
 
 
-def start(*, http_options: Optional[Dict[str, Any]] = None, proxy: bool = False):
+def start(*, http_options: Optional[Dict[str, Any]] = None, proxy: bool = False,
+          grpc_options: Optional[Dict[str, Any]] = None):
     """Start serve system actors (reference: serve.start). The HTTP
     proxy starts on demand (serve.run(..., route_prefix=...) or
-    proxy=True)."""
-    global _proxy
+    proxy=True); pass grpc_options={"port": N} for the gRPC ingress
+    (reference: serve.start(grpc_options=gRPCOptions(...)))."""
+    global _proxy, _grpc_proxy
     import ray_tpu
 
     if not ray_tpu.is_initialized():
@@ -159,7 +164,32 @@ def start(*, http_options: Optional[Dict[str, Any]] = None, proxy: bool = False)
             opts.get("host", "127.0.0.1"), opts.get("port", 8000)
         )
         ray_tpu.get(_proxy.ping.remote())
+    if grpc_options is not None and _grpc_proxy is None:
+        grpc_cls = ray_tpu.remote(
+            __import__(
+                "ray_tpu.serve._private.proxy", fromlist=["GrpcIngress"]
+            ).GrpcIngress
+        )
+        _grpc_proxy = grpc_cls.options(
+            max_concurrency=64, num_cpus=0.1
+        ).remote(
+            grpc_options.get("host", "127.0.0.1"),
+            grpc_options.get("port", 9000),
+        )
+        # ping returns the BOUND port (0 = ephemeral pick)
+        global _grpc_port
+        _grpc_port = ray_tpu.get(_grpc_proxy.ping.remote())
     return controller
+
+
+def grpc_port() -> int:
+    """The gRPC ingress's bound port (after serve.start(grpc_options=...));
+    raises if the ingress is not running."""
+    if _grpc_port is None:
+        raise RuntimeError(
+            "gRPC ingress is not running; pass grpc_options to serve.start"
+        )
+    return _grpc_port
 
 
 def _collect_deployments(app: Application, out: Dict[str, DeploymentInfo], route_prefix):
@@ -256,7 +286,7 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _proxy
+    global _proxy, _grpc_proxy, _grpc_port
     import ray_tpu
 
     try:
@@ -271,6 +301,17 @@ def shutdown() -> None:
         except Exception:
             pass
         _proxy = None
+    if _grpc_proxy is not None:
+        try:
+            ray_tpu.get(_grpc_proxy.stop.remote(), timeout=5)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(_grpc_proxy)
+        except Exception:
+            pass
+        _grpc_proxy = None
+        _grpc_port = None
 
 from ray_tpu._private import usage as _usage
 

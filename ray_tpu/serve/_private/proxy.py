@@ -114,3 +114,91 @@ class HTTPProxy:
             self._routes = ray_tpu.get(controller.get_routes.remote())
         except Exception:
             pass
+
+
+class GrpcIngress:
+    """gRPC ingress (reference: serve/_private/proxy.py gRPCProxy +
+    grpc_util.py). A generic unary-unary service — no protoc step:
+    requests route by the `route` metadata key (falling back to the
+    first segment of the method path, mirroring the reference's
+    `application` metadata routing), the deployment receives
+    {"grpc_method", "body", "metadata"} and returns bytes/str/JSON-able,
+    serialized back as raw response bytes."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9000):
+        import time as _time
+        from concurrent import futures
+
+        import grpc
+
+        self.host = host
+        self._routes: Dict[str, str] = {}
+        self._routes_refreshed = float("-inf")
+        self._handles: Dict[str, Any] = {}
+        ingress = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, details):
+                method = details.method
+
+                def call(request: bytes, context):
+                    return ingress._call(method, request, context)
+
+                return grpc.unary_unary_rpc_method_handler(
+                    call,
+                    request_deserializer=None,   # raw bytes in
+                    response_serializer=None,    # raw bytes out
+                )
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16)
+        )
+        self._server.add_generic_rpc_handlers((_Generic(),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        self._time = _time
+
+    def ping(self) -> int:
+        return self.port
+
+    def _call(self, method: str, request: bytes, context) -> bytes:
+        import grpc
+
+        if self._time.monotonic() - self._routes_refreshed > 1.0:
+            self._routes_refreshed = self._time.monotonic()
+            self._refresh_routes()
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
+        route = md.get("route")
+        if route is None:
+            # "/pkg.Service/Method" -> "/pkg.Service"
+            route = "/" + method.strip("/").split("/")[0]
+        name = self._match(route if route.startswith("/") else f"/{route}")
+        if name is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"no deployment matches route {route!r}",
+            )
+        handle = self._handles.get(name)
+        if handle is None:
+            from ..handle import DeploymentHandle
+
+            handle = DeploymentHandle(name)
+            self._handles[name] = handle
+        req = {"grpc_method": method, "body": request, "metadata": md}
+        try:
+            result = handle.remote(req).result(timeout_s=60)
+        except Exception as e:  # noqa: BLE001
+            context.abort(
+                grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+            )
+        if isinstance(result, (bytes, bytearray)):
+            return bytes(result)
+        if isinstance(result, str):
+            return result.encode()
+        return json.dumps(result).encode()
+
+    _match = HTTPProxy._match
+    _refresh_routes = HTTPProxy._refresh_routes
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)

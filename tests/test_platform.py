@@ -660,3 +660,44 @@ def test_workflow_events_exactly_once(ray_start_regular, tmp_path):
     assert out == "hello:7"
     assert polls.read_text() == "p"  # still exactly one poll
     assert workflow.get_status("wf_ev") == "SUCCEEDED"
+
+
+def test_dashboard_timeline_and_data_stats(ray_start_regular, tmp_path):
+    """Dashboard renders what the cluster already collects (reference:
+    dashboard/modules/state + data section): the chrome-trace timeline
+    endpoint carries task spans, and dataset executions publish per-op
+    stats that /api/data_stats serves."""
+    import urllib.request
+
+    import ray_tpu.data as rdata
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    ray_tpu.get([work.remote(i) for i in range(4)])
+    # a dataset execution publishes per-op stats to the KV
+    ds = rdata.range(32).map(lambda r: {"v": r["id"] * 2})
+    assert len(ds.take_all()) == 32
+
+    dash = start_dashboard(port=0)
+    try:
+        base = f"http://127.0.0.1:{dash.port}"
+        tl = json.loads(urllib.request.urlopen(base + "/api/timeline").read())
+        spans = [e for e in tl if e.get("ph") == "X" and e.get("dur", 0) > 0]
+        assert spans, "timeline must carry task spans"
+        assert any(e["name"].startswith("work") for e in spans)
+
+        stats = json.loads(
+            urllib.request.urlopen(base + "/api/data_stats").read()
+        )
+        assert stats, "dataset execution must publish stats"
+        stages = stats[-1]["stages"]
+        assert any("map" in s["name"].lower() for s in stages)
+        assert all("wall_s" in s and "blocks" in s for s in stages)
+
+        html = urllib.request.urlopen(base + "/").read().decode()
+        assert "timeline" in html and "data ops" in html
+    finally:
+        dash.stop()
