@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 from .batching import batch
 from .handle import DeploymentHandle, DeploymentResponse
 from .multiplex import get_multiplexed_model_id, multiplexed
+from .response import Response
 from ._private.controller import CONTROLLER_NAME, DeploymentInfo, ServeController
 
 __all__ = [
@@ -23,6 +24,7 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "Response",
     "batch",
     "delete",
     "deployment",

@@ -98,8 +98,41 @@ class HTTPProxy:
             )
         except Exception as e:
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        from ..response import Response as ServeResponse
+
+        if isinstance(result, ServeResponse):
+            # explicit status/content-type/headers from the deployment
+            # (reference: returning a starlette Response). aiohttp
+            # forbids (a) a Content-Type header alongside the
+            # content_type param and (b) a charset inside content_type
+            # — normalize both starlette-style spellings.
+            headers = {
+                k: v for k, v in result.headers.items()
+                if k.lower() != "content-type"
+            }
+            ctype = next(
+                (v for k, v in result.headers.items()
+                 if k.lower() == "content-type"),
+                result.content_type,
+            )
+            charset = None
+            if ";" in ctype:
+                ctype, _, rest = ctype.partition(";")
+                ctype = ctype.strip()
+                rest = rest.strip()
+                if rest.lower().startswith("charset="):
+                    charset = rest.split("=", 1)[1]
+            return web.Response(
+                status=result.status,
+                body=result.body_bytes(),
+                content_type=ctype,
+                charset=charset,
+                headers=headers,
+            )
         if isinstance(result, (bytes, bytearray)):
-            return web.Response(body=bytes(result))
+            return web.Response(
+                body=bytes(result), content_type="application/octet-stream"
+            )
         if isinstance(result, str):
             return web.Response(text=result)
         return web.json_response(result)
@@ -191,6 +224,18 @@ class GrpcIngress:
             context.abort(
                 grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
             )
+        from ..response import Response as ServeResponse
+
+        if isinstance(result, ServeResponse):
+            # shared deployments may return serve.Response on either
+            # ingress: gRPC carries the body; an error status maps to
+            # an INTERNAL abort (no HTTP status channel here)
+            if result.status >= 400:
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    f"deployment returned status {result.status}",
+                )
+            return result.body_bytes()
         if isinstance(result, (bytes, bytearray)):
             return bytes(result)
         if isinstance(result, str):

@@ -288,3 +288,60 @@ def test_grpc_ingress(ray_start_4_cpus):
         chan.close()
     finally:
         serve.shutdown()
+
+
+def test_http_content_type_negotiation(serve_cleanup):
+    """Non-JSON payloads (reference: starlette Response passthrough):
+    bytes get octet-stream, serve.Response controls status/content-type
+    /headers explicitly — no silent JSON coercion of binary bodies."""
+    import urllib.request
+
+    @serve.deployment
+    class Bin:
+        def __call__(self, req):
+            if req["path"].endswith("/png"):
+                return serve.Response(
+                    b"\x89PNG...", content_type="image/png",
+                    headers={"X-Model": "demo"},
+                )
+            if req["path"].endswith("/teapot"):
+                return serve.Response("short and stout", status=418)
+            if req["path"].endswith("/hdr"):
+                # starlette-style: type via headers, charset in value
+                return serve.Response(
+                    "<b>hi</b>",
+                    headers={"Content-Type": "text/html; charset=utf-8"},
+                )
+            return bytes(range(16))
+
+    serve.run(Bin.bind(), route_prefix="/bin",
+              http_options={"port": 18767})
+    base = "http://127.0.0.1:18767/bin"
+    deadline = time.time() + 15
+    r = None
+    while time.time() < deadline:
+        try:
+            r = urllib.request.urlopen(base + "/raw", timeout=5)
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert r is not None
+    assert r.headers["Content-Type"] == "application/octet-stream"
+    assert r.read() == bytes(range(16))
+
+    r = urllib.request.urlopen(base + "/png", timeout=10)
+    assert r.headers["Content-Type"] == "image/png"
+    assert r.headers["X-Model"] == "demo"
+    assert r.read().startswith(b"\x89PNG")
+
+    r = urllib.request.urlopen(base + "/hdr", timeout=10)
+    assert r.headers["Content-Type"].startswith("text/html")
+    assert r.read() == b"<b>hi</b>"
+
+    import urllib.error
+    try:
+        urllib.request.urlopen(base + "/teapot", timeout=10)
+        assert False, "expected 418"
+    except urllib.error.HTTPError as e:
+        assert e.code == 418
+        assert e.read() == b"short and stout"

@@ -1,0 +1,138 @@
+"""Multi-host hard cases (SURVEY §7.4): a 4-host jax.distributed gang
+and host-loss-driven gang restart + elastic resize across agents. Own
+module: each test builds its own Cluster, which cannot coexist with
+another module's live module-scoped cluster in one driver process."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+# -------------------------------------------------------- hard cases
+def test_four_host_gang_rendezvous():
+    """A 4-process jax.distributed gang spanning FOUR hosts (each host
+    has exactly 1 CPU, so the gang cannot pack smaller) — the pod-scale
+    shape of §7.4 on the simulated cluster."""
+    from ray_tpu.air.config import ScalingConfig
+    from ray_tpu.train.backend import JaxConfig
+    from ray_tpu.train.jax_trainer import JaxTrainer
+
+    c = Cluster(head_num_cpus=1)
+    for _ in range(3):
+        c.add_node(num_cpus=1)
+    try:
+        def fn(config):
+            import os
+
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            from ray_tpu.train import session
+
+            gathered = np.asarray(
+                multihost_utils.process_allgather(
+                    jnp.array([float(jax.process_index())])
+                )
+            ).reshape(-1)
+            session.report({
+                "rank_sum": float(gathered.sum()),
+                "n_processes": jax.process_count(),
+                "node": os.environ.get("RAY_TPU_NODE_ID", "node0"),
+            })
+
+        seen_nodes = set()
+        trainer = JaxTrainer(
+            train_loop_per_worker=fn,
+            scaling_config=ScalingConfig(
+                num_workers=4, resources_per_worker={"CPU": 1}
+            ),
+            jax_config=JaxConfig(enable_distributed=True),
+        )
+        result = trainer.fit()
+        assert result.error is None, result.error
+        assert result.metrics["n_processes"] == 4
+        assert result.metrics["rank_sum"] == 6.0  # 0+1+2+3
+    finally:
+        c.shutdown()
+
+
+def test_host_loss_triggers_gang_restart_and_elastic_resize(tmp_path):
+    """Kill a HOST (agent process) mid-training: the gang worker on it
+    dies, the restart at full size is unschedulable on the survivors,
+    and elastic resize completes the run at half size from the latest
+    checkpoint (§7.4's host-loss + elastic-across-agents case)."""
+    import threading
+    import time as _time
+
+    from ray_tpu import train
+    from ray_tpu.air.config import FailureConfig, ScalingConfig
+    from ray_tpu.train import Checkpoint, RunConfig
+    from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+    c = Cluster(head_num_cpus=1)
+    node_b = c.add_node(num_cpus=1)
+    marker = tmp_path / "rank1_started"
+    try:
+        def loop(config):
+            import os
+
+            from ray_tpu.train import session
+
+            ctx = session.get_context()
+            start = 0
+            ckpt = train.get_checkpoint()
+            if ckpt is not None:
+                start = ckpt.to_state()["step"] + 1
+            for i in range(start, 4):
+                if i == 2 and ctx.get_world_size() == 2:
+                    # full-size attempt parks the WHOLE gang at step 2:
+                    # the off-head rank signals the driver and both
+                    # ranks wait for the host kill (gang is
+                    # all-or-nothing — rank 0 must not finish early)
+                    import time
+
+                    if os.environ.get("RAY_TPU_NODE_ID", "node0") != "node0":
+                        open(config["marker"], "w").close()
+                    time.sleep(120)
+                session.report(
+                    {"step": i, "world": ctx.get_world_size()},
+                    checkpoint=Checkpoint.from_state({"step": i}),
+                )
+
+        trainer = DataParallelTrainer(
+            loop,
+            train_loop_config={"marker": str(marker)},
+            scaling_config=ScalingConfig(
+                num_workers=2,
+                resources_per_worker={"CPU": 1},
+                min_workers=1,
+                placement_timeout_s=3.0,
+            ),
+            run_config=RunConfig(
+                name="hostloss", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=3),
+            ),
+        )
+
+        def killer():
+            deadline = _time.monotonic() + 60
+            while _time.monotonic() < deadline:
+                if marker.exists():
+                    c.remove_node(node_b)
+                    return
+                _time.sleep(0.2)
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        result = trainer.fit()
+        kt.join(timeout=60)
+        assert result.error is None, result.error
+        # survived the host loss; finished all steps at reduced size
+        assert result.metrics["step"] == 3
+        assert result.metrics["world"] == 1
+        assert marker.exists()  # the doomed rank really ran on node B
+    finally:
+        c.shutdown()

@@ -288,3 +288,57 @@ def test_pb2_end_to_end_tuner(ray_start_4_cpus):
     results = tuner.fit()
     assert len(results) == 4
     assert results.get_best_result().metrics["score"] > 0
+
+
+def test_concurrency_limiter_caps_inflight(ray_start_4_cpus, tmp_path):
+    """ConcurrencyLimiter (reference: tune/search/concurrency_limiter.py):
+    never more than max_concurrent trials hold a live suggestion, and
+    completions release slots so every sample still runs."""
+    import json
+    import os
+
+    from ray_tpu import tune
+    from ray_tpu.tune import ConcurrencyLimiter
+    from ray_tpu.tune.search import BasicVariantGenerator
+
+    peak_file = tmp_path / "peak.json"
+    peak_file.write_text("0")
+    live_file = tmp_path / "live.json"
+    live_file.write_text("0")
+
+    def trainable(config):
+        import fcntl
+        import time
+
+        # track max concurrently-RUNNING trials via a lock-guarded file
+        def bump(delta):
+            with open(live_file, "r+") as f:
+                fcntl.flock(f, fcntl.LOCK_EX)
+                live = int(f.read() or 0) + delta
+                f.seek(0); f.truncate(); f.write(str(live))
+                peak = int(peak_file.read_text() or 0)
+                if live > peak:
+                    peak_file.write_text(str(live))
+            return live
+
+        bump(+1)
+        time.sleep(0.3)
+        bump(-1)
+        tune.report({"loss": config["x"]})
+
+    from ray_tpu.train import RunConfig
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    base = BasicVariantGenerator({"x": tune.grid_search([1, 2, 3, 4, 5])})
+    tuner = Tuner(
+        trainable,
+        tune_config=TuneConfig(
+            search_alg=ConcurrencyLimiter(base, max_concurrent=2),
+            metric="loss", mode="min", num_samples=5,
+        ),
+        run_config=RunConfig(name="climit", storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 5              # every sample still ran
+    assert results.get_best_result().metrics["loss"] == 1
+    assert int(peak_file.read_text()) <= 2, "cap exceeded"
