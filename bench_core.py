@@ -327,7 +327,7 @@ ray_tpu.shutdown()
 """
     try:
         out = subprocess.run(
-            [_sys.executable, "-c", script], capture_output=True,
+            [sys.executable, "-c", script], capture_output=True,
             text=True, timeout=300,
         )
         rate = next(
@@ -336,7 +336,7 @@ ray_tpu.shutdown()
         )
         report("client_put_gigabytes", rate, "GiB/s")
     except Exception as e:  # noqa: BLE001
-        print(f"client_put_gigabytes failed: {e}", file=_sys.stderr)
+        print(f"client_put_gigabytes failed: {e}", file=sys.stderr)
     finally:
         ray_tpu.shutdown()
 
