@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from .. import exceptions
 from . import protocol as P
+from .debug import log_exc
 from .ids import ActorID, ObjectID, TaskID
 from .object_store import INLINE_THRESHOLD, ShmObjectStore
 from .serialization import dumps_inline, loads_inline
@@ -165,13 +166,24 @@ class CoreClient:
                     continue
                 self._dispatch_inbound(msg_type, payload)
         except (EOFError, OSError):
-            self._closed = True
-            with self._pending_lock:
-                pending, self._pending = self._pending, {}
-            for fut in pending.values():
-                if not fut.done():
-                    fut.set_exception(ConnectionError("hub connection lost"))
-            self.task_queue.put((P.KILL, {}))
+            self._fail_pending("hub connection lost")
+        except Exception:
+            # A dispatch bug used to kill the reader thread bare, which
+            # hangs every pending future forever. Surface the bug AND
+            # fail the futures loudly, then re-raise so it stays visible
+            # as a crash rather than being silently swallowed (GL002).
+            log_exc("client reader error")
+            self._fail_pending("client reader crashed (see stderr)")
+            raise
+
+    def _fail_pending(self, why: str) -> None:
+        self._closed = True
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError(why))
+        self.task_queue.put((P.KILL, {}))
 
     def _on_objs_freed(self, oids) -> None:
         """Runs on the reader thread (pubsub callback): drop freed ids
@@ -483,8 +495,11 @@ class CoreClient:
         ReferenceCounter RemoveLocalReference -> eviction).
 
         Called from ObjectRef.__del__ — must stay lock-free (plain
-        append only); the flusher thread ships the batch."""
-        self._release_buf.append(oid)
+        append only); the flusher thread ships the batch. __del__ may
+        preempt a thread that already holds our locks, so taking one
+        here can deadlock — flush()'s swap-then-drain tolerates the
+        unlocked append."""
+        self._release_buf.append(oid)  # graftlint: disable=GL001
 
     # ----------------------------------------------------------------- tasks
     def register_function(self, fn_id: str, blob: bytes) -> None:

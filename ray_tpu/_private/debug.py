@@ -1,0 +1,19 @@
+"""Last-resort error reporting for control-plane threads.
+
+The hub reactor, timers, and the client reader all follow the same
+rule: a stray exception must cost one unit of work (a connection, a
+timer tick), never the thread — but the traceback has to surface
+somewhere. This is the one place that banner format lives.
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+
+
+def log_exc(prefix: str) -> None:
+    """Write the active exception's traceback to stderr under the
+    ``[ray_tpu]`` banner. For broad-``except`` arms where raising is
+    not an option and losing the traceback is worse."""
+    sys.stderr.write(f"[ray_tpu] {prefix}:\n{traceback.format_exc()}\n")

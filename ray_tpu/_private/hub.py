@@ -43,6 +43,7 @@ from multiprocessing.connection import Listener, wait as conn_wait
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import protocol as P
+from .debug import log_exc
 from .ids import WorkerID
 from .serialization import dumps_inline, loads_inline
 
@@ -469,11 +470,7 @@ class Hub:
                 try:
                     cb()
                 except Exception:
-                    import traceback
-
-                    sys.stderr.write(
-                        f"[ray_tpu] hub timer error:\n{traceback.format_exc()}\n"
-                    )
+                    log_exc("hub timer error")
             self._flush_outbox()
             timeout = None
             if self.timers:
@@ -492,17 +489,18 @@ class Hub:
                             self._handle(r, msg_type, payload)
                         except Exception:
                             # A handler bug must never kill the control plane.
-                            import traceback
-
-                            sys.stderr.write(
-                                f"[ray_tpu] hub handler error on {msg_type}:\n"
-                                f"{traceback.format_exc()}\n"
-                            )
+                            log_exc(f"hub handler error on {msg_type}")
                         self._flush_outbox()
                         if not r.poll(0):
                             break
                 except (EOFError, OSError):
-                    self._handle_disconnect(r)
+                    self._safe_disconnect(r)
+                except Exception:
+                    # a stray bug in the recv/dispatch path must cost
+                    # one connection, never the reactor thread — every
+                    # client in the session hangs if this loop dies
+                    log_exc("hub reactor error (dropping conn)")
+                    self._safe_disconnect(r)
         # teardown
         for w in self.workers.values():
             self._kill_worker(w)
@@ -2134,6 +2132,24 @@ class Hub:
                 pass
 
     # ----- worker failure handling
+    def _safe_disconnect(self, conn):
+        """_handle_disconnect behind a last-resort guard: it runs from
+        the reactor's except paths, where a raising cleanup would kill
+        the hub thread (the very bug class it is cleaning up after)."""
+        try:
+            self._handle_disconnect(conn)
+        except Exception:
+            log_exc("hub disconnect cleanup error")
+        finally:
+            # the broad-except path reaches here with the socket still
+            # live; without a close the peer never sees EOF and blocks
+            # in recv forever (and the hub leaks the fd). Last line of
+            # defense: nothing here may raise.
+            try:
+                conn.close()
+            except Exception:
+                pass
+
     def _handle_disconnect(self, conn):
         if conn in self.client_conns:
             self.client_conns.remove(conn)
@@ -2141,6 +2157,12 @@ class Hub:
         cid_ = id(conn)
         for key in [k for k in self._client_puts if k[0] == cid_]:
             f = self._client_puts.pop(key)
+            if isinstance(f, tuple):
+                # ('failed', msg) tombstone from _on_put_chunk — the
+                # file is already closed and unlinked; touching .name
+                # here used to raise AttributeError and kill the hub
+                # thread on a mid-chunked-put disconnect
+                continue
             try:
                 name = f.name
                 f.close()

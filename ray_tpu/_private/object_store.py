@@ -302,29 +302,52 @@ class ShmObjectStore:
     def free(self, name: str) -> None:
         with self._lock:
             seg = self._segments.pop(name, None)
+            if seg is not None and seg.writable:
+                cap = len(seg.mm)
+                # advisory pre-check: skip the rename+unlink round-trip
+                # when the pool is already full. Going stale here only
+                # forgoes a recycle — the authoritative check before
+                # insert below is what enforces the caps.
+                no_room = (
+                    self._pool_bytes + cap > _POOL_MAX_BYTES
+                    or len(self._pool) >= _POOL_MAX_SEGMENTS
+                )
         if seg is not None and seg.writable:
-            cap = len(seg.mm)
+            # Recycle the warm pages under an anonymous name. Free means
+            # "no live borrowers" (same contract as the reference's
+            # ray._private.internal_api.free — objects are deleted even
+            # if still referenced); a racing unlink by the hub just
+            # defeats the recycle. Rename FIRST, then check pool room
+            # and insert under ONE lock acquisition: checking under a
+            # separate acquisition let two concurrent frees both pass
+            # the byte-cap test and blow past _POOL_MAX_BYTES.
+            if no_room:
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    pass
+                return
+            pooled = os.path.join(self.dir, f".pool.{uuid.uuid4().hex}")
+            try:
+                os.rename(seg.path, pooled)
+            except OSError:
+                return  # hub already unlinked it; drop the segment
+            seg.path = pooled
             with self._lock:
-                room = (
+                if (
                     self._pool_bytes + cap <= _POOL_MAX_BYTES
                     and len(self._pool) < _POOL_MAX_SEGMENTS
-                )
-            if room:
-                # Recycle the warm pages under an anonymous name. Free
-                # means "no live borrowers" (same contract as the
-                # reference's ray._private.internal_api.free — objects
-                # are deleted even if still referenced); a racing
-                # unlink by the hub just defeats the recycle.
-                pooled = os.path.join(self.dir, f".pool.{uuid.uuid4().hex}")
-                try:
-                    os.rename(seg.path, pooled)
-                except OSError:
-                    return  # hub already unlinked it; drop the segment
-                seg.path = pooled
-                with self._lock:
+                ):
                     self._pool.append((cap, seg))
                     self._pool_bytes += cap
-                return
+                    return
+            # pool is full after all: drop the renamed file (the mmap
+            # stays valid for any live views)
+            try:
+                os.unlink(pooled)
+            except OSError:
+                pass
+            return
         # The mmap stays valid for existing views even after unlink.
         try:
             os.unlink(self._path(name))

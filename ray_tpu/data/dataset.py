@@ -819,7 +819,9 @@ class _StreamSplit(Dataset):
 
         waited = 0.0
         while True:
-            out = ray_tpu.get(self._coord.next_block.remote(self._cid))
+            # per-block protocol round-trip: blocks are consumed
+            # strictly in order, there is nothing to batch
+            out = ray_tpu.get(self._coord.next_block.remote(self._cid))  # graftlint: disable=GL004
             if isinstance(out, str) and out == "__wait__":
                 # epoch barrier: siblings must exhaust the epoch too
                 if waited >= self.BARRIER_TIMEOUT_S:

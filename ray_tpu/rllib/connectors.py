@@ -113,7 +113,11 @@ class NormalizeObservations(ConnectorV2):
         if self.update and not peek and len(flat):
             if self._mean is None:
                 self._mean = np.zeros(flat.shape[1], np.float64)
-                self._m2 = np.ones(flat.shape[1], np.float64)
+                # zeros, not ones: _m2 is the running sum of squared
+                # deviations — a ones seed adds a phantom unit of
+                # variance per feature and biases early std estimates
+                # upward (GL006)
+                self._m2 = np.zeros(flat.shape[1], np.float64)
             # batched Chan's parallel-moments merge: one vectorized
             # update per batch instead of a per-row Python loop (this
             # runs in the rollout hot path)

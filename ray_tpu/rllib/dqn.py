@@ -10,6 +10,7 @@ numpy (random access) and only sampled minibatches hit the device.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple, Union
 
@@ -173,7 +174,7 @@ class _EpsilonGreedyRunner:
         self.rng = np.random.default_rng(seed)
         self.obs, _ = self.envs.reset(seed=seed)
         self._ep_returns = np.zeros(num_envs)
-        self.completed: list = []
+        self.completed: deque = deque(maxlen=100)  # trailing window (GL005)
 
     def obs_space_dim(self):
         return int(np.prod(self.envs.single_observation_space.shape))
@@ -216,7 +217,7 @@ class _EpsilonGreedyRunner:
                 self._ep_returns[i] = 0.0
             obs = next_obs
         self.obs = obs
-        out["episode_returns"] = np.asarray(self.completed[-100:], np.float32)
+        out["episode_returns"] = np.asarray(list(self.completed), np.float32)
         return out
 
 

@@ -9,6 +9,7 @@ learner can device_put them straight into HBM.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -71,7 +72,9 @@ class SingleAgentEnvRunner:
         # episode-return bookkeeping
         self._ep_returns = np.zeros(num_envs)
         self._ep_lens = np.zeros(num_envs, dtype=np.int64)
-        self.completed_returns: List[float] = []
+        # trailing window only; a plain list leaks for the runner's
+        # lifetime (GL005)
+        self.completed_returns: deque = deque(maxlen=100)
 
     def obs_space_dim(self) -> int:
         return int(np.prod(self.envs.single_observation_space.shape))
@@ -146,7 +149,7 @@ class SingleAgentEnvRunner:
                 self._ep_lens[i] = 0
             obs = next_obs
         self.obs = obs
-        stats_returns = self.completed_returns[-100:]
+        stats_returns = list(self.completed_returns)
         return {
             "obs": obs_buf,
             "actions": act_buf,

@@ -19,6 +19,7 @@ TPU-native differences:
 from __future__ import annotations
 
 import math
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -295,7 +296,9 @@ class MultiAgentEnvRunner:
         self.seed = seed
         self._ep_seed = 0 if seed is None else seed
         self.episodes: List[Optional[MultiAgentEpisode]] = [None] * num_envs
-        self.completed_returns: List[float] = []
+        # bounded: only the trailing window is ever reported, and a
+        # plain list leaks for the runner's lifetime (GL005)
+        self.completed_returns: deque = deque(maxlen=100)
         self._needs_reset = True
         # per-module env→module connector pipelines (reference:
         # config.env_to_module_connector building ConnectorV2 stacks)
@@ -430,7 +433,7 @@ class MultiAgentEnvRunner:
         return {
             "sequences": sequences,
             "episode_returns": np.asarray(
-                self.completed_returns[-100:], np.float32
+                list(self.completed_returns), np.float32
             ),
             "env_steps": env_steps,
         }

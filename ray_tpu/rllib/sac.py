@@ -10,6 +10,7 @@ jitted policy forward and ship flat numpy transitions.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple, Union
 
@@ -261,7 +262,7 @@ class _GaussianRunner:
         self._step = 0
         self.obs, _ = self.envs.reset(seed=seed)
         self._ep_returns = np.zeros(num_envs)
-        self.completed: list = []
+        self.completed: deque = deque(maxlen=100)  # trailing window (GL005)
 
     def space_dims(self):
         return (
@@ -312,7 +313,7 @@ class _GaussianRunner:
                 self._ep_returns[i] = 0.0
             obs = next_obs
         self.obs = obs
-        out["episode_returns"] = np.asarray(self.completed[-100:], np.float32)
+        out["episode_returns"] = np.asarray(list(self.completed), np.float32)
         return out
 
 

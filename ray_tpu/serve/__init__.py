@@ -248,12 +248,23 @@ def run(
     controller = start(proxy=_http or route_prefix is not None, http_options=http_options)
     infos: Dict[str, DeploymentInfo] = {}
     _collect_deployments(app, infos, route_prefix)
-    for info in infos.values():
-        ray_tpu.get(controller.deploy.remote(info))
+    # submit every deploy before blocking (controller tasks execute in
+    # submission order); unlike the old one-at-a-time loop, a failing
+    # deploy no longer stops later ones from being submitted, so on
+    # failure tear the whole app down rather than leave it half-live
+    try:
+        ray_tpu.get([controller.deploy.remote(info) for info in infos.values()])
+    except Exception:
+        down = [controller.delete_deployment.remote(n) for n in infos]
+        try:
+            ray_tpu.get(down)
+        except Exception:
+            pass
+        raise
     # wait until every deployment has live replicas
     deadline = time.monotonic() + 60
     while time.monotonic() < deadline:
-        if ray_tpu.get(controller.ready.remote()):
+        if ray_tpu.get(controller.ready.remote()):  # graftlint: disable=GL004 — readiness poll
             break
         time.sleep(0.05)
     handle = DeploymentHandle(app.deployment.name)

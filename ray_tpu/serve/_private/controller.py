@@ -199,16 +199,18 @@ class ServeController:
             for name in list(self._model_ids):
                 if name not in targets:
                     del self._model_ids[name]
-            # miss counters only for replicas that still exist (retired
-            # generations would otherwise leak entries forever)
             live_rids = {
                 a._actor_id.binary()
                 for actors in self._replicas.values()
                 for a in actors
             }
-            for rid in list(self._ping_misses):
-                if rid not in live_rids:
-                    del self._ping_misses[rid]
+        # miss counters only for replicas that still exist (retired
+        # generations would otherwise leak entries forever). Pruned
+        # outside the lock: _ping_misses is reconcile-thread-only state,
+        # only _replicas needs self._lock.
+        for rid in list(self._ping_misses):
+            if rid not in live_rids:
+                del self._ping_misses[rid]
 
     def _start_replica(self, info: DeploymentInfo):
         import ray_tpu

@@ -1,0 +1,2 @@
+"""Developer tooling that ships with the repo (not part of the runtime
+API surface). Currently: :mod:`ray_tpu.tools.graftlint`."""

@@ -1,0 +1,116 @@
+"""CLI: ``python -m ray_tpu.tools.graftlint [paths] [options]``.
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when
+new findings exist, 2 on usage errors. Findings print one per line as
+``path:line GLxxx message``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .core import (
+    DEFAULT_BASELINE_PATH,
+    all_checkers,
+    check_paths,
+    load_baseline,
+    write_baseline,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.tools.graftlint",
+        description=(
+            "AST-based concurrency & distributed-runtime invariant "
+            "checker for this repo (rules GL001-GL006; see the package "
+            "README)."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["ray_tpu"],
+        help="files or directories to check (default: ray_tpu)",
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE_PATH, metavar="FILE",
+        help="baseline JSON of accepted findings "
+             "(default: the packaged baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline; report every finding",
+    )
+    parser.add_argument(
+        "--write-baseline", metavar="FILE",
+        help="write all current findings to FILE as the new baseline "
+             "and exit 0",
+    )
+    parser.add_argument(
+        "--select", metavar="CODES",
+        help="comma-separated rule codes to run (e.g. GL001,GL005)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit",
+    )
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="suppress the summary line; print findings only",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, name, _fn in sorted(all_checkers()):
+            print(f"{code}  {name}")
+        return 0
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    codes = None
+    if args.select:
+        codes = {c.strip().upper() for c in args.select.split(",") if c.strip()}
+        known = {code for code, _name, _fn in all_checkers()}
+        unknown = sorted(codes - known)
+        if unknown:
+            # a typo'd code must not silently run zero checkers and
+            # green-light the tree
+            print(
+                f"graftlint: unknown rule code(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(known))})",
+                file=sys.stderr,
+            )
+            return 2
+
+    baseline = (
+        set() if (args.no_baseline or args.write_baseline)
+        else load_baseline(args.baseline)
+    )
+    new, old = check_paths(args.paths, baseline=baseline, codes=codes)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, new + old)
+        if not args.quiet:
+            print(
+                f"graftlint: wrote {len(new) + len(old)} finding(s) to "
+                f"{args.write_baseline}"
+            )
+        return 0
+
+    for f in new:
+        print(f.render())
+    if not args.quiet:
+        suffix = f" ({len(old)} baselined)" if old else ""
+        print(
+            f"graftlint: {len(new)} finding(s){suffix}",
+            file=sys.stderr,
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Checker registry: importing this package registers every checker
+with :func:`ray_tpu.tools.graftlint.core.register`. Add a new rule by
+dropping a module here and importing it below (see README.md)."""
+
+from . import (  # noqa: F401
+    gl001_lock_discipline,
+    gl002_reactor_except,
+    gl003_blocking_async,
+    gl004_remote_misuse,
+    gl005_unbounded_accumulator,
+    gl006_accumulator_init,
+)

@@ -1,0 +1,85 @@
+"""GL003 — blocking call inside ``async def``.
+
+The serve proxy and actor event loops run many requests on one thread;
+a single synchronous sleep, file read, subprocess, or socket round-trip
+inside a coroutine stalls *every* in-flight request on that loop (the
+reference's "blocking call in asyncio loop" anti-pattern).
+
+Flags calls to a known-blocking API inside an ``async def`` body
+(nested sync ``def``s are excluded — they execute wherever they're
+called). Resolution goes through the file's imports, so ``from time
+import sleep`` / ``import subprocess as sp`` are caught too.
+
+Fix shape: ``await asyncio.sleep(...)``, ``loop.run_in_executor(...)``,
+or move the work to a worker thread before entering the coroutine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import FileContext, Finding, dotted_name, qualname_map, register
+
+_BLOCKING = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_call": "use `await asyncio.create_subprocess_exec(...)`",
+    "subprocess.check_output": "use `await asyncio.create_subprocess_exec(...)`",
+    "os.system": "use `await asyncio.create_subprocess_shell(...)`",
+    "os.popen": "use `await asyncio.create_subprocess_shell(...)`",
+    "socket.create_connection": "use `await asyncio.open_connection(...)`",
+    "urllib.request.urlopen": "use an async client or run_in_executor",
+    "requests.get": "use an async client or run_in_executor",
+    "requests.post": "use an async client or run_in_executor",
+    "requests.put": "use an async client or run_in_executor",
+    "requests.delete": "use an async client or run_in_executor",
+    "requests.head": "use an async client or run_in_executor",
+    "requests.request": "use an async client or run_in_executor",
+    "open": "read via run_in_executor (sync file IO blocks the loop)",
+}
+
+
+def _async_body_calls(fn: ast.AsyncFunctionDef):
+    """Calls lexically inside the coroutine (not nested sync defs)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # sync defs run wherever they're *called*; nested async
+            # defs are visited by check() themselves — descending here
+            # too would report their calls once per enclosing coroutine
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@register("GL003", "blocking-call-in-async")
+def check(ctx: FileContext) -> List[Finding]:
+    out: List[Finding] = []
+    quals = qualname_map(ctx.tree)
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        qual = quals.get(id(fn), fn.name)
+        for call in _async_body_calls(fn):
+            name = ctx.resolve(dotted_name(call.func))
+            hint = _BLOCKING.get(name or "")
+            if hint is None:
+                continue
+            out.append(
+                Finding(
+                    path=ctx.path,
+                    line=call.lineno,
+                    code="GL003",
+                    message=(
+                        f"blocking `{name}(...)` inside `async def "
+                        f"{fn.name}` stalls every request on this event "
+                        f"loop — {hint}"
+                    ),
+                    symbol=f"{qual}.{name}",
+                )
+            )
+    return out

@@ -1,0 +1,334 @@
+"""graftlint core: findings, suppression, baseline, and the file runner.
+
+graftlint is a repo-specific static analyzer for the concurrency and
+distributed-runtime invariants of this codebase (see README.md in this
+directory). It is stdlib-only (`ast` + `json`) so it can run inside the
+tier-1 test gate with no extra dependencies.
+
+Design notes:
+
+- Checkers are plain functions ``check(ctx) -> list[Finding]`` registered
+  via :func:`register`. Keeping them stateless functions (no accumulating
+  instance attributes) is deliberate — the analyzer lints its own package.
+- Findings are fingerprinted as ``(path, code, symbol)`` rather than by
+  line number, so a baseline survives unrelated edits to the same file.
+- Two suppression mechanisms:
+  * inline: a ``# graftlint: disable=GL001,GL004`` (or bare
+    ``# graftlint: disable``) comment on the flagged line;
+  * baseline: a JSON file of fingerprints for accepted findings, loaded
+    with ``--baseline`` (the packaged ``baseline.json`` by default).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "register",
+    "all_checkers",
+    "check_file",
+    "check_paths",
+    "load_baseline",
+    "write_baseline",
+    "DEFAULT_BASELINE_PATH",
+]
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_DISABLE_MARKER = "graftlint: disable"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One reported violation.
+
+    ``symbol`` is a stable anchor (usually ``Class.method`` or
+    ``Class.method.attr``) used for baseline fingerprints instead of the
+    line number, which churns with unrelated edits.
+    """
+
+    path: str
+    line: int
+    code: str
+    message: str
+    symbol: str = ""
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (_norm_path(self.path), self.code, self.symbol)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line} {self.code} {self.message}"
+
+
+@dataclass
+class FileContext:
+    """Everything a checker gets to look at for one file."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    # module alias -> full module name ("np" -> "numpy"); from-imports
+    # map the bound name to its dotted origin ("sleep" -> "time.sleep")
+    import_aliases: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, source: Optional[str] = None) -> "FileContext":
+        if source is None:
+            with tokenize.open(path) as f:
+                source = f.read()
+        tree = ast.parse(source, filename=path)
+        ctx = cls(path=path, source=source, tree=tree,
+                  lines=source.splitlines())
+        ctx.import_aliases = _collect_imports(tree)
+        return ctx
+
+    def resolve(self, dotted: Optional[str]) -> Optional[str]:
+        """Expand the leading segment of a dotted name through the
+        file's imports: with ``import numpy as np``, ``np.ones`` ->
+        ``numpy.ones``; with ``from time import sleep``, ``sleep`` ->
+        ``time.sleep``."""
+        if dotted is None:
+            return None
+        head, sep, rest = dotted.partition(".")
+        full = self.import_aliases.get(head)
+        if full is None:
+            return dotted
+        return full + sep + rest
+
+
+# ------------------------------------------------------------------ registry
+
+CheckerFn = Callable[[FileContext], List[Finding]]
+_CHECKERS: List[Tuple[str, str, CheckerFn]] = []
+
+
+def register(code: str, name: str) -> Callable[[CheckerFn], CheckerFn]:
+    def deco(fn: CheckerFn) -> CheckerFn:
+        _CHECKERS.append((code, name, fn))
+        return fn
+
+    return deco
+
+
+def all_checkers() -> List[Tuple[str, str, CheckerFn]]:
+    from . import checkers as _checkers  # noqa: F401  (registration side effect)
+
+    return list(_CHECKERS)
+
+
+# ------------------------------------------------------------------- helpers
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``x`` for ``self.x``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def walk_local(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested function/class defs
+    (so per-function analyses stay per-function)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def qualname_map(tree: ast.Module) -> Dict[int, str]:
+    """``id(def-node) -> "Outer.inner"`` for every function/class def,
+    so checkers can emit collision-free baseline symbols (two
+    same-named methods in different classes must not share a
+    fingerprint)."""
+    out: Dict[int, str] = {}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                out[id(child)] = qual
+                visit(child, qual)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _collect_imports(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _norm_path(path: str) -> str:
+    """Stable fingerprint path: keep the trailing components from the
+    package root down, so the baseline works from any CWD."""
+    p = path.replace(os.sep, "/")
+    for anchor in ("ray_tpu/", "tests/"):
+        idx = p.find(anchor)
+        if idx >= 0:
+            return p[idx:]
+    return os.path.basename(p)
+
+
+# --------------------------------------------------------------- suppression
+
+
+def _suppressed(finding: Finding, ctx: FileContext) -> bool:
+    if 1 <= finding.line <= len(ctx.lines):
+        line = ctx.lines[finding.line - 1]
+        idx = line.find(_DISABLE_MARKER)
+        if idx >= 0:
+            spec = line[idx + len(_DISABLE_MARKER):].lstrip()
+            if not spec.startswith("="):
+                return True  # bare "graftlint: disable" — all codes
+            codes = spec[1:].split("#", 1)[0]
+            # tolerate trailing prose: "disable=GL004 — readiness poll"
+            parts = {
+                c.strip().split()[0]
+                for c in codes.split(",")
+                if c.strip()
+            }
+            return finding.code in parts
+    return False
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def load_baseline(path: Optional[str]) -> Set[Tuple[str, str, str]]:
+    if path is None or not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return {
+        (e["path"], e["code"], e.get("symbol", ""))
+        for e in data.get("entries", [])
+    }
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    entries = sorted(
+        {f.fingerprint() for f in findings},
+    )
+    data = {
+        "version": 1,
+        "comment": (
+            "Accepted graftlint findings. Each entry is fingerprinted by "
+            "(path, code, symbol), not line, so it survives unrelated "
+            "edits. Remove entries as the underlying code is fixed."
+        ),
+        "entries": [
+            {"path": p, "code": c, "symbol": s} for p, c, s in entries
+        ],
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+# -------------------------------------------------------------------- runner
+
+
+def check_file(
+    path: str,
+    source: Optional[str] = None,
+    codes: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """All (non-inline-suppressed) findings for one file."""
+    try:
+        ctx = FileContext.parse(path, source)
+    except (SyntaxError, UnicodeDecodeError) as err:
+        return [
+            Finding(
+                path=path,
+                line=getattr(err, "lineno", 1) or 1,
+                code="GL000",
+                message=f"could not parse: {err.__class__.__name__}: {err}",
+                symbol="<parse>",
+            )
+        ]
+    out: List[Finding] = []
+    for code, _name, fn in all_checkers():
+        if codes is not None and code not in codes:
+            continue
+        for f in fn(ctx):
+            if not _suppressed(f, ctx):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "node_modules")
+                )
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        yield os.path.join(root, fname)
+        else:
+            # a file named explicitly is linted regardless of extension
+            # (e.g. an executable script) — silently skipping it would
+            # report a false "0 finding(s)" green
+            yield p
+
+
+def check_paths(
+    paths: Sequence[str],
+    baseline: Optional[Set[Tuple[str, str, str]]] = None,
+    codes: Optional[Set[str]] = None,
+) -> Tuple[List[Finding], List[Finding]]:
+    """Returns (new_findings, baselined_findings)."""
+    baseline = baseline or set()
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for fpath in iter_python_files(paths):
+        for f in check_file(fpath, codes=codes):
+            (old if f.fingerprint() in baseline else new).append(f)
+    return new, old

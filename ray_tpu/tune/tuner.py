@@ -301,7 +301,9 @@ class Tuner:
 
             for trial in list(running):
                 try:
-                    poll = ray.get(trial.actor.poll.remote())
+                    # sequential by design: per-trial error attribution
+                    # needs each poll's exception on its own trial
+                    poll = ray.get(trial.actor.poll.remote())  # graftlint: disable=GL004
                 except Exception as e:
                     trial.status = "ERROR"
                     trial.error = str(e)
@@ -321,7 +323,8 @@ class Tuner:
                         dirty = True
                     decision = scheduler.on_result(trial.trial_id, metrics)
                     if decision == STOP:
-                        ray.get(trial.actor.request_stop.remote())
+                        # rare control-path call, one trial at a time
+                        ray.get(trial.actor.request_stop.remote())  # graftlint: disable=GL004
                 # PBT exploit hook — only for trials still mid-training;
                 # a finished/errored trial's poll flags belong to the OLD
                 # actor and would immediately kill the exploit restart

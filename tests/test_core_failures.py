@@ -214,3 +214,55 @@ def test_retry_exceptions_type_filter(ray_start_regular):
         with pytest.raises(TaskError):
             ray_tpu.get(wrong_type.remote(), timeout=60)
         assert os.path.getsize(marker) == 1  # ValueError not in the list
+
+
+# ------------------------------------------- hub disconnect hardening
+
+
+def _bare_hub(tmp_path):
+    from ray_tpu._private.hub import Hub
+
+    return Hub(session_dir=str(tmp_path / "session"), resources={"CPU": 1})
+
+
+def test_disconnect_with_failed_put_tombstone(tmp_path):
+    """Regression: a client that dies mid-chunked-put after its stream
+    was poisoned leaves a ('failed', msg) tombstone in _client_puts.
+    The disconnect cleanup used to call .name on it (AttributeError)
+    and kill the hub reactor thread."""
+    hub = _bare_hub(tmp_path)
+    try:
+        conn = object()
+        objdir = os.path.join(hub.session_dir, "objects")
+        os.makedirs(objdir, exist_ok=True)
+        live = open(os.path.join(objdir, ".client.live.seg"), "wb")
+        hub._client_puts[(id(conn), "poisoned")] = ("failed", "disk full")
+        hub._client_puts[(id(conn), "live")] = live
+        hub._handle_disconnect(conn)  # must not raise
+        assert not [k for k in hub._client_puts if k[0] == id(conn)]
+        assert live.closed
+        assert not os.path.exists(live.name)
+    finally:
+        hub.listener.close()
+
+
+def test_safe_disconnect_never_raises(tmp_path):
+    """_safe_disconnect is the reactor's last line of defense: even a
+    cleanup bug must cost one connection, not the hub thread."""
+    hub = _bare_hub(tmp_path)
+    try:
+        class Boom:
+            # id() collides with nothing; make the cleanup itself blow
+            pass
+
+        conn = Boom()
+        hub.conn_to_worker[conn] = "w-missing"
+        hub.workers.clear()
+
+        def exploding(_conn):
+            raise RuntimeError("cleanup bug")
+
+        hub._handle_disconnect = exploding
+        hub._safe_disconnect(conn)  # swallowed + logged, not raised
+    finally:
+        hub.listener.close()

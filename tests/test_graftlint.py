@@ -1,0 +1,777 @@
+"""graftlint: per-checker fixtures + the package-wide zero-findings gate.
+
+Every checker has at least one flagged fixture (the bug shape, mirroring
+real defects this repo has shipped) and one clean fixture (the fixed
+shape). The gate test runs the analyzer over the whole ``ray_tpu``
+package (not ``tests/``, which trips GL004 by design in its fixtures)
+and fails on any non-baselined finding — so the invariants hold on
+every tier-1 run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.tools.graftlint import (
+    DEFAULT_BASELINE_PATH,
+    check_file,
+    check_paths,
+    load_baseline,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_DIR = os.path.join(REPO_ROOT, "ray_tpu")
+
+
+def codes_of(source, path="fixture.py"):
+    return sorted({f.code for f in check_file(path, source=textwrap.dedent(source))})
+
+
+# --------------------------------------------------------------------- GL001
+
+
+def test_gl001_flags_split_check_then_act():
+    # mirrors the object_store.free() race: room checked under one
+    # acquisition, pool mutated under another
+    src = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pool = []
+            self._pool_bytes = 0
+
+        def free(self, cap):
+            with self._lock:
+                room = self._pool_bytes + cap <= 100 and len(self._pool) < 8
+            if room:
+                with self._lock:
+                    self._pool.append(cap)
+                    self._pool_bytes += cap
+    """
+    assert "GL001" in codes_of(src)
+
+
+def test_gl001_clean_when_check_and_act_share_one_acquisition():
+    src = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pool = []
+            self._pool_bytes = 0
+
+        def free(self, cap):
+            with self._lock:
+                if self._pool_bytes + cap <= 100 and len(self._pool) < 8:
+                    self._pool.append(cap)
+                    self._pool_bytes += cap
+    """
+    assert codes_of(src) == []
+
+
+def test_gl001_clean_when_act_block_revalidates():
+    # double-checked locking that re-tests under the acting acquisition
+    src = """
+    import threading
+
+    class Store:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._pool = []
+
+        def free(self, cap):
+            with self._lock:
+                room = len(self._pool) < 8
+            if room:
+                with self._lock:
+                    if len(self._pool) < 8:
+                        self._pool.append(cap)
+    """
+    assert codes_of(src) == []
+
+
+def test_gl001_flags_unguarded_write():
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def incr(self):
+            with self._lock:
+                self._n += 1
+
+        def sneak(self):
+            self._n += 1
+    """
+    findings = check_file("fixture.py", source=textwrap.dedent(src))
+    assert any(f.code == "GL001" and "sneak" in f.symbol for f in findings)
+
+
+def test_gl001_allows_init_and_locked_writes():
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def incr(self):
+            with self._lock:
+                self._n += 1
+    """
+    assert codes_of(src) == []
+
+
+def test_gl001_inline_suppression():
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def incr(self):
+            with self._lock:
+                self._n += 1
+
+        def sneak(self):
+            self._n += 1  # graftlint: disable=GL001 — single-writer path
+    """
+    assert codes_of(src) == []
+
+
+# --------------------------------------------------------------------- GL002
+
+
+HUB_SHAPE = """
+import threading
+
+class Hub:
+    def start(self):
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        while self._running:
+            for r in self.wait():
+                try:
+                    while True:
+                        msg = r.recv()
+                        try:
+                            self.handle(r, msg)
+                        except Exception:
+                            self.log()
+                        if not r.poll(0):
+                            break
+                except (EOFError, OSError):
+                    self._handle_disconnect(r)
+"""
+
+
+def test_gl002_flags_narrow_except_doing_cleanup():
+    # mirrors the hub reactor bug: _handle_disconnect raising
+    # AttributeError escaped (EOFError, OSError) and killed the thread
+    assert "GL002" in codes_of(HUB_SHAPE)
+
+
+def test_gl002_clean_with_broad_arm():
+    src = HUB_SHAPE + """
+"""
+    src = src.replace(
+        "                except (EOFError, OSError):\n"
+        "                    self._handle_disconnect(r)",
+        "                except (EOFError, OSError):\n"
+        "                    self._handle_disconnect(r)\n"
+        "                except Exception:\n"
+        "                    self.log()\n"
+        "                    self._handle_disconnect(r)",
+    )
+    assert codes_of(src) == []
+
+
+def test_gl002_ignores_pure_control_flow_handlers():
+    # `except queue.Empty: break` is an idiomatic signal, not a bug
+    src = """
+    import queue
+    import threading
+
+    class Worker:
+        def start(self):
+            threading.Thread(target=self._loop, daemon=True).start()
+
+        def _loop(self):
+            while self._running:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                self.process(item)
+    """
+    assert codes_of(src) == []
+
+
+def test_gl002_ignores_non_thread_functions():
+    # same shape outside a Thread target: not a daemon-loop concern
+    src = """
+    def pump(conn):
+        while True:
+            try:
+                conn.send(conn.recv())
+            except (EOFError, OSError):
+                conn.close()
+    """
+    assert codes_of(src) == []
+
+
+def test_gl002_flags_loop_wrapped_by_narrow_try():
+    src = """
+    import threading
+
+    class Client:
+        def start(self):
+            threading.Thread(target=self._read_loop, daemon=True).start()
+
+        def _read_loop(self):
+            try:
+                while True:
+                    self.dispatch(self.conn.recv())
+            except (EOFError, OSError):
+                self.fail_pending()
+    """
+    assert "GL002" in codes_of(src)
+
+
+# --------------------------------------------------------------------- GL003
+
+
+def test_gl003_flags_blocking_calls_in_async():
+    src = """
+    import subprocess
+    import time
+
+    async def handler(request):
+        time.sleep(0.1)
+        subprocess.run(["ls"])
+        return request
+    """
+    findings = check_file("fixture.py", source=textwrap.dedent(src))
+    assert sum(f.code == "GL003" for f in findings) == 2
+
+
+def test_gl003_resolves_import_aliases():
+    src = """
+    from time import sleep
+
+    async def handler(request):
+        sleep(0.1)
+    """
+    assert "GL003" in codes_of(src)
+
+
+def test_gl003_clean_async_and_nested_sync():
+    src = """
+    import asyncio
+    import time
+
+    async def handler(request):
+        await asyncio.sleep(0.1)
+
+        def sync_helper():
+            time.sleep(0.1)  # runs wherever it's *called*, not here
+
+        return sync_helper
+    """
+    assert codes_of(src) == []
+
+
+def test_gl003_ignores_sync_functions():
+    src = """
+    import time
+
+    def poll():
+        time.sleep(0.1)
+    """
+    assert codes_of(src) == []
+
+
+# --------------------------------------------------------------------- GL004
+
+
+def test_gl004_flags_discarded_object_ref():
+    src = """
+    def fire(actor):
+        actor.ping.remote()
+    """
+    assert "GL004" in codes_of(src)
+
+
+def test_gl004_clean_when_ref_is_kept():
+    src = """
+    import ray_tpu
+
+    def fire(actor):
+        ref = actor.ping.remote()
+        return ray_tpu.get(ref)
+    """
+    assert codes_of(src) == []
+
+
+def test_gl004_flags_get_of_fresh_ref_in_loop():
+    src = """
+    import ray_tpu
+
+    def poll_all(actors):
+        out = []
+        for a in actors:
+            out.append(ray_tpu.get(a.step.remote()))
+        return out
+    """
+    assert "GL004" in codes_of(src)
+
+
+def test_gl004_flags_get_of_fresh_ref_in_comprehension():
+    # the comprehension spelling of the serialized round-trip — the
+    # natural "rewrite" of a flagged for-loop — must stay flagged
+    src = """
+    import ray_tpu
+
+    def poll_all(actors):
+        return [ray_tpu.get(a.step.remote()) for a in actors]
+    """
+    assert "GL004" in codes_of(src)
+
+
+def test_gl004_clean_batched_get():
+    # getting a list of refs submitted together is the good pattern,
+    # even inside an outer loop
+    src = """
+    import ray_tpu
+
+    def train(runners):
+        for _ in range(10):
+            rollouts = ray_tpu.get([r.sample.remote() for r in runners])
+            consume(rollouts)
+    """
+    assert codes_of(src) == []
+
+
+def test_gl004_flags_lock_passed_to_remote():
+    src = """
+    import threading
+
+    def submit(actor):
+        lock = threading.Lock()
+        return actor.run.remote(lock)
+    """
+    assert "GL004" in codes_of(src)
+
+
+def test_gl004_flags_self_lock_arg():
+    src = """
+    class Driver:
+        def submit(self, actor):
+            return actor.run.remote(self._lock)
+    """
+    assert "GL004" in codes_of(src)
+
+
+def test_gl004_flags_lock_passed_as_keyword():
+    src = """
+    class Driver:
+        def submit(self, actor):
+            return actor.run.remote(arg=self._lock)
+    """
+    assert "GL004" in codes_of(src)
+
+
+def test_gl004_clean_plain_args():
+    src = """
+    def submit(actor, payload):
+        return actor.run.remote(payload, 3, key="v")
+    """
+    assert codes_of(src) == []
+
+
+# --------------------------------------------------------------------- GL005
+
+
+def test_gl005_flags_unbounded_instance_list():
+    # mirrors MultiAgentEnvRunner.completed_returns: appended per
+    # finished episode, only the [-100:] window ever read
+    src = """
+    class Runner:
+        def __init__(self):
+            self.completed_returns = []
+
+        def sample(self):
+            for ep in self.episodes:
+                if ep.is_done:
+                    self.completed_returns.append(ep.total_return())
+            return self.completed_returns[-100:]
+    """
+    assert "GL005" in codes_of(src)
+
+
+def test_gl005_flags_annotated_init():
+    src = """
+    from typing import List
+
+    class Runner:
+        def __init__(self):
+            self.completed_returns: List[float] = []
+
+        def sample(self):
+            for ep in self.episodes:
+                self.completed_returns.append(ep.ret)
+    """
+    assert "GL005" in codes_of(src)
+
+
+def test_gl005_clean_with_deque_maxlen():
+    src = """
+    from collections import deque
+
+    class Runner:
+        def __init__(self):
+            self.completed_returns = deque(maxlen=100)
+
+        def sample(self):
+            for ep in self.episodes:
+                if ep.is_done:
+                    self.completed_returns.append(ep.total_return())
+            return list(self.completed_returns)
+    """
+    assert codes_of(src) == []
+
+
+def test_gl005_clean_when_trimmed_or_reassigned():
+    src = """
+    class Batcher:
+        def __init__(self):
+            self.buf = []
+
+        def add_all(self, items):
+            for it in items:
+                self.buf.append(it)
+
+        def drain(self):
+            out, self.buf = self.buf, []
+            return out
+    """
+    assert codes_of(src) == []
+
+
+def test_gl005_module_level_and_memo_exemption():
+    flagged = """
+    LOG = []
+
+    def record(events):
+        for e in events:
+            LOG.append(e)
+    """
+    assert "GL005" in codes_of(flagged)
+    memo = """
+    TABLE = []
+
+    def table():
+        if not TABLE:
+            for i in range(256):
+                TABLE.append(i * 7)
+        return TABLE
+    """
+    assert codes_of(memo) == []
+
+
+# --------------------------------------------------------------------- GL006
+
+
+def test_gl006_flags_ones_seeded_accumulator():
+    # mirrors NormalizeObservations._m2: a += accumulator seeded ones
+    src = """
+    import numpy as np
+
+    class Norm:
+        def update(self, batch):
+            if self._m2 is None:
+                self._mean = np.zeros(4)
+                self._m2 = np.ones(4)
+            self._m2 += batch.var(axis=0)
+    """
+    assert "GL006" in codes_of(src)
+
+
+def test_gl006_clean_zeros_seed_and_non_accumulated_ones():
+    src = """
+    import numpy as np
+
+    class Norm:
+        def update(self, batch):
+            if self._m2 is None:
+                self._m2 = np.zeros(4)
+                self._scale = np.ones(4)  # multiplicative: ones is right
+            self._m2 += batch.var(axis=0)
+            self._scale = self._scale * 0.99
+    """
+    assert codes_of(src) == []
+
+
+# ---------------------------------------------------------- infrastructure
+
+
+def test_baseline_round_trip(tmp_path):
+    src = textwrap.dedent(
+        """
+        def fire(actor):
+            actor.ping.remote()
+        """
+    )
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    new, old = check_paths([str(f)])
+    assert [x.code for x in new] == ["GL004"] and old == []
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(bl_path, new)
+    baseline = load_baseline(bl_path)
+    new2, old2 = check_paths([str(f)], baseline=baseline)
+    assert new2 == [] and [x.code for x in old2] == ["GL004"]
+    # fingerprints are line-insensitive: shifting the file doesn't
+    # invalidate the baseline entry
+    f.write_text("# a new leading comment\n" + src)
+    new3, old3 = check_paths([str(f)], baseline=baseline)
+    assert new3 == [] and len(old3) == 1
+
+
+def test_syntax_error_reports_gl000(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text("def broken(:\n")
+    findings = check_file(str(f))
+    assert [x.code for x in findings] == ["GL000"]
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def fire(actor):\n    actor.ping.remote()\n")
+    good = tmp_path / "good.py"
+    good.write_text("def add(a, b):\n    return a + b\n")
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(good)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 1
+    assert "GL004" in r.stdout
+
+    # --write-baseline accepts the findings; a rerun against it is clean
+    bl = tmp_path / "bl.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad),
+         "--write-baseline", str(bl)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0
+    assert json.loads(bl.read_text())["entries"]
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad),
+         "--baseline", str(bl)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0
+
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint",
+         str(tmp_path / "missing.py")],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 2
+
+    # a typo'd --select must not silently run zero checkers and pass
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad),
+         "--select", "GL04"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 2
+    assert "unknown rule code" in r.stderr
+
+    # an explicitly-named file is linted even without a .py extension
+    script = tmp_path / "worker_script"
+    script.write_text(bad.read_text())
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(script)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 1
+    assert "GL004" in r.stdout
+
+
+def test_same_named_methods_get_distinct_fingerprints():
+    # two classes with a same-named reactor method must not share a
+    # baseline fingerprint, or baselining one hides the other
+    src = textwrap.dedent("""
+    import threading
+
+    class A:
+        def start(self):
+            threading.Thread(target=self._run).start()
+
+        def _run(self):
+            while True:
+                try:
+                    self.step()
+                except OSError:
+                    self.cleanup()
+
+    class B:
+        def start(self):
+            threading.Thread(target=self._run).start()
+
+        def _run(self):
+            while True:
+                try:
+                    self.step()
+                except OSError:
+                    self.cleanup()
+    """)
+    findings = [
+        f for f in check_file("x.py", source=src) if f.code == "GL002"
+    ]
+    assert len(findings) == 2
+    assert len({f.fingerprint() for f in findings}) == 2
+
+
+def test_gl003_nested_coroutine_reported_once():
+    src = textwrap.dedent("""
+    import time
+
+    async def outer():
+        async def inner():
+            time.sleep(1)
+        await inner()
+    """)
+    findings = [
+        f for f in check_file("x.py", source=src) if f.code == "GL003"
+    ]
+    assert len(findings) == 1
+    assert "inner" in findings[0].symbol
+
+
+# ------------------------------------------------- the four shipped bugs
+
+
+def test_reverting_hub_disconnect_fix_is_flagged():
+    """The hub bug: `except (EOFError, OSError)` around the recv loop
+    called _handle_disconnect, whose _client_puts cleanup raised
+    AttributeError on ('failed', msg) tombstones — killing the hub."""
+    assert "GL002" in codes_of(HUB_SHAPE)
+
+
+def test_reverting_object_store_free_fix_is_flagged():
+    src = """
+    import os
+    import threading
+    import uuid
+
+    class ShmObjectStore:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._segments = {}
+            self._pool = []
+            self._pool_bytes = 0
+
+        def free(self, name):
+            with self._lock:
+                seg = self._segments.pop(name, None)
+            if seg is not None and seg.writable:
+                cap = len(seg.mm)
+                with self._lock:
+                    room = (
+                        self._pool_bytes + cap <= 2**31
+                        and len(self._pool) < 8
+                    )
+                if room:
+                    pooled = f".pool.{uuid.uuid4().hex}"
+                    os.rename(seg.path, pooled)
+                    seg.path = pooled
+                    with self._lock:
+                        self._pool.append((cap, seg))
+                        self._pool_bytes += cap
+    """
+    assert "GL005" not in codes_of(src)
+    assert "GL001" in codes_of(src)
+
+
+def test_reverting_connectors_m2_fix_is_flagged():
+    src = """
+    import numpy as np
+
+    class NormalizeObservations:
+        def __call__(self, batch):
+            flat = np.asarray(batch["obs"])
+            if self._mean is None:
+                self._mean = np.zeros(flat.shape[1], np.float64)
+                self._m2 = np.ones(flat.shape[1], np.float64)
+            self._m2 += ((flat - flat.mean(0)) ** 2).sum(0)
+    """
+    assert "GL006" in codes_of(src)
+
+
+def test_reverting_multi_agent_deque_fix_is_flagged():
+    src = """
+    from typing import List, Optional
+
+    class MultiAgentEnvRunner:
+        def __init__(self, num_envs=1):
+            self.episodes: List[Optional[object]] = [None] * num_envs
+            self.completed_returns: List[float] = []
+
+        def sample(self):
+            for i, ep in enumerate(self.episodes):
+                if ep.is_done:
+                    self.completed_returns.append(ep.total_return())
+            return self.completed_returns[-100:]
+    """
+    assert "GL005" in codes_of(src)
+
+
+# ------------------------------------------------------------- repo gate
+
+
+def test_repo_is_clean_under_graftlint():
+    """The tier-1 gate: zero non-baselined findings over ray_tpu/.
+
+    If this fails, either fix the flagged code, suppress the line with
+    `# graftlint: disable=GLxxx — why`, or (for accepted debt) add the
+    fingerprint to ray_tpu/tools/graftlint/baseline.json.
+    """
+    baseline = load_baseline(DEFAULT_BASELINE_PATH)
+    new, _old = check_paths([PKG_DIR], baseline=baseline)
+    assert new == [], "new graftlint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+
+
+def test_every_checker_is_exercised_by_the_gate_config():
+    from ray_tpu.tools.graftlint import all_checkers
+
+    codes = {code for code, _name, _fn in all_checkers()}
+    assert codes == {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006"}

@@ -130,3 +130,71 @@ def test_wait_timeout_unregisters_waiter(capped_runtime):
             break
         time.sleep(0.05)
     assert not hub.obj_wait_waiters.get(ghost._id.binary())
+
+
+# ----------------------------------------------------- segment-pool cap
+
+
+def test_concurrent_free_respects_pool_cap(tmp_path, monkeypatch):
+    """Regression: free() used to check pool room under one lock
+    acquisition and insert under another, so concurrent frees could all
+    pass the byte-cap test and blow past _POOL_MAX_BYTES. The fixed
+    path re-checks and inserts under a single acquisition."""
+    import threading
+
+    from ray_tpu._private import object_store as os_mod
+    from ray_tpu._private.object_store import ShmObjectStore
+
+    seg_payload = np.zeros(64 * 1024, np.uint8)
+    store = ShmObjectStore(str(tmp_path))
+    # one segment comfortably over half the cap: ANY two pooled
+    # segments exceed it, so a double-insert is always a cap breach
+    size = store.put("probe", seg_payload)
+    monkeypatch.setattr(os_mod, "_POOL_MAX_BYTES", int(size * 1.5))
+    store.free("probe")
+
+    for round_i in range(10):
+        names = [f"obj{round_i}_{j}" for j in range(4)]
+        for n in names:
+            store.put(n, seg_payload)
+        barrier = threading.Barrier(len(names))
+
+        def free_one(name):
+            barrier.wait()
+            store.free(name)
+
+        threads = [
+            threading.Thread(target=free_one, args=(n,)) for n in names
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with store._lock:
+            assert store._pool_bytes <= int(size * 1.5), (
+                round_i, store._pool_bytes
+            )
+            assert len(store._pool) <= os_mod._POOL_MAX_SEGMENTS
+            assert store._pool_bytes == sum(c for c, _ in store._pool)
+
+
+def test_free_unpooled_segment_is_unlinked(tmp_path, monkeypatch):
+    """When the pool has no room, the renamed segment file must be
+    unlinked, not leaked under its anonymous .pool.* name."""
+    from ray_tpu._private import object_store as os_mod
+    from ray_tpu._private.object_store import ShmObjectStore
+
+    store = ShmObjectStore(str(tmp_path))
+    monkeypatch.setattr(os_mod, "_POOL_MAX_SEGMENTS", 1)
+    a = np.zeros(32 * 1024, np.uint8)
+    store.put("a", a)
+    store.put("b", a)
+    store.free("a")  # fills the single pool slot
+    store.free("b")  # no room: must unlink, not pool
+    with store._lock:
+        assert len(store._pool) == 1
+    leftovers = [
+        f for f in os.listdir(store.dir) if not f.startswith(".pool.")
+    ]
+    assert leftovers == []
+    assert len([f for f in os.listdir(store.dir)]) == 1

@@ -253,6 +253,25 @@ def test_connector_units():
     assert out["obs"].shape == (2, 8)
 
 
+def test_normalize_small_sample_std_unbiased():
+    """Regression: _m2 must start at zeros (the additive identity), not
+    ones — a ones seed adds a phantom unit of variance per feature and
+    inflates small-sample std estimates (normalized outputs read low)."""
+    from ray_tpu.rllib import NormalizeObservations
+
+    norm = NormalizeObservations(clip=100.0)
+    batch = np.array([[0.0], [2.0], [4.0]], np.float32)  # mean 2, m2 8
+    norm({"obs": batch})
+    st = norm.state()
+    assert st["count"] == 3.0
+    np.testing.assert_allclose(st["mean"], [2.0], atol=1e-9)
+    # sum of squared deviations exactly; ones-seeded would report 9
+    np.testing.assert_allclose(st["m2"], [8.0], atol=1e-6)
+    # normalized output uses the unbiased sample std sqrt(8/2) = 2
+    out = norm({"obs": batch}, peek=True)["obs"]
+    np.testing.assert_allclose(out[:, 0], [-1.0, 0.0, 1.0], atol=1e-5)
+
+
 def test_multi_agent_with_connector_pipeline(ray_start_4_cpus):
     """env→module connectors wired through the multi-agent runner: the
     module trains on stacked frames (obs_dim doubles) and learner
