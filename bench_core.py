@@ -12,7 +12,15 @@ and a trailing summary line. Baselines were measured on an m4.16xlarge
 box has 1-2 cores), so treat vs_baseline as directional for the
 control-plane rows and exact for the in-memory ones.
 
-Run: python bench_core.py [--quick]
+Run: python bench_core.py [--quick] [--smoke] [--json PATH]
+
+--quick    one trial with reduced iteration counts (the mode perf PRs
+           commit before/after JSON from; see README "Benchmarking")
+--smoke    micro-iterations only: every BASELINES metric still runs and
+           is reported, but with counts sized for a CI smoke test
+           (tests/test_bench_harness.py); numbers are NOT comparable
+--json     also write {"metrics": {...}, "geomean_vs_baseline": N} to
+           PATH (the BENCH_pr*_{before,after}.json convention)
 """
 
 from __future__ import annotations
@@ -43,8 +51,28 @@ BASELINES = {
     "placement_group_create_removal": 752.0,
 }
 
-QUICK = "--quick" in sys.argv
+SMOKE = False
+QUICK = False
+JSON_PATH = None
 RESULTS = []
+
+
+def _parse_argv(argv) -> None:
+    """Flag parsing stays out of import time: tests import this module
+    for BASELINES, and pytest's argv must neither configure a bench
+    mode nor trip the --json validation sys.exit at collection."""
+    global SMOKE, QUICK, JSON_PATH
+    SMOKE = "--smoke" in argv
+    QUICK = "--quick" in argv or SMOKE
+    if "--json" in argv:
+        try:
+            JSON_PATH = argv[argv.index("--json") + 1]
+        except IndexError:
+            sys.exit("--json requires a path argument")
+        if JSON_PATH.startswith("-"):
+            sys.exit(
+                f"--json requires a path argument, got flag {JSON_PATH!r}"
+            )
 
 
 def report(metric: str, value: float, unit: str) -> None:
@@ -75,7 +103,7 @@ def timeit(fn, warmup: int = 1, trials: int = 3) -> float:
 def main() -> None:
     import ray_tpu
 
-    ray_tpu.init(num_cpus=8, max_workers=8)
+    ray_tpu.init(num_cpus=8, max_workers=4 if SMOKE else 8)
 
     @ray_tpu.remote
     def nullary():
@@ -124,10 +152,11 @@ def main() -> None:
             return n * len(self.targets)
 
     # warm the worker pool so spawn latency isn't measured
-    ray_tpu.get([nullary.remote() for _ in range(16)])
+    ray_tpu.get([nullary.remote() for _ in range(4 if SMOKE else 16)])
 
-    N_SYNC = 200 if QUICK else 1000
-    N_ASYNC = 2000 if QUICK else 10000
+    N_SYNC = 10 if SMOKE else (200 if QUICK else 1000)
+    N_ASYNC = 40 if SMOKE else (2000 if QUICK else 10000)
+    N_CLIENTS = 2 if SMOKE else 4
 
     def tasks_sync():
         for _ in range(N_SYNC):
@@ -144,11 +173,13 @@ def main() -> None:
 
     # 4 client processes each submitting a quarter of the tasks
     # (reference shape: ray_perf.py "multi client tasks async")
-    task_clients = [Client.remote() for _ in range(4)]
+    task_clients = [Client.remote() for _ in range(N_CLIENTS)]
     ray_tpu.get([c.task_batch.remote(4) for c in task_clients])
 
     def tasks_multi():
-        ray_tpu.get([c.task_batch.remote(N_ASYNC // 4) for c in task_clients])
+        ray_tpu.get(
+            [c.task_batch.remote(N_ASYNC // N_CLIENTS) for c in task_clients]
+        )
         return N_ASYNC
 
     report("multi_client_tasks_async", timeit(tasks_multi), "tasks/s")
@@ -179,7 +210,7 @@ def main() -> None:
 
     report("1_1_actor_calls_concurrent", timeit(actor_concurrent), "calls/s")
 
-    n_actors = 4
+    n_actors = N_CLIENTS
     actors = [Sink.remote() for _ in range(n_actors)]
     ray_tpu.get([x.ping.remote() for x in actors])
 
@@ -260,7 +291,10 @@ def main() -> None:
 
     report("single_client_put_calls", timeit(put_calls), "ops/s")
 
-    big = np.random.randint(0, 256, (256 * 1024 * 1024,), dtype=np.uint8)
+    big = np.random.randint(
+        0, 256, (4 * 1024 * 1024 if SMOKE else 256 * 1024 * 1024,),
+        dtype=np.uint8,
+    )
 
     def put_gb():
         # free between puts: sustained throughput with the object
@@ -278,7 +312,7 @@ def main() -> None:
         # ready ref per wait() call until all 1000 are drained
         n = 1 if QUICK else 3
         for _ in range(n):
-            not_ready = [nullary.remote() for _ in range(1000)]
+            not_ready = [nullary.remote() for _ in range(100 if SMOKE else 1000)]
             while not_ready:
                 _ready, not_ready = ray_tpu.wait(not_ready, timeout=60)
         return n
@@ -292,7 +326,7 @@ def main() -> None:
     )
 
     def pg_churn():
-        n = 50 if QUICK else 200
+        n = 5 if SMOKE else (50 if QUICK else 200)
         for _ in range(n):
             pg = placement_group([{"CPU": 0.01}])
             pg.wait(10)
@@ -303,10 +337,39 @@ def main() -> None:
 
     ray_tpu.shutdown()
 
+    if not SMOKE:
+        _bench_client_mode()
+
+    ratios = [r["vs_baseline"] for r in RESULTS if r["vs_baseline"]]
+    geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
+    summary = {
+        "metric": "core_microbench_geomean_vs_baseline",
+        "value": round(geomean, 3),
+        "unit": "ratio",
+        "vs_baseline": round(geomean, 3),
+        "detail": {r["metric"]: r["value"] for r in RESULTS},
+    }
+    print(json.dumps(summary))
+    if JSON_PATH:
+        with open(JSON_PATH, "w") as f:
+            json.dump(
+                {
+                    "mode": "smoke" if SMOKE else ("quick" if QUICK else "full"),
+                    "metrics": {r["metric"]: r for r in RESULTS},
+                    "geomean_vs_baseline": round(geomean, 3),
+                },
+                f, indent=2,
+            )
+            f.write("\n")
+
+
+def _bench_client_mode() -> None:
     # ---- client-mode object plane (no reference baseline: the
     # reference's client microbenchmarks aren't in BASELINE.md; the row
     # documents the chunk-streaming path's throughput)
     import subprocess
+
+    import ray_tpu
 
     ctx = ray_tpu.init(num_cpus=2, max_workers=2, _tcp_hub=True)
     script = f"""
@@ -340,16 +403,7 @@ ray_tpu.shutdown()
     finally:
         ray_tpu.shutdown()
 
-    ratios = [r["vs_baseline"] for r in RESULTS if r["vs_baseline"]]
-    geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
-    print(json.dumps({
-        "metric": "core_microbench_geomean_vs_baseline",
-        "value": round(geomean, 3),
-        "unit": "ratio",
-        "vs_baseline": round(geomean, 3),
-        "detail": {r["metric"]: r["value"] for r in RESULTS},
-    }))
-
 
 if __name__ == "__main__":
+    _parse_argv(sys.argv[1:])
     main()

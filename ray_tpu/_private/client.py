@@ -28,7 +28,12 @@ from . import protocol as P
 from .debug import log_exc
 from .ids import ActorID, ObjectID, TaskID
 from .object_store import INLINE_THRESHOLD, ShmObjectStore
-from .serialization import dumps_inline, loads_inline
+from .serialization import (
+    dumps_frame,
+    dumps_inline,
+    loads_frame,
+    loads_inline,
+)
 
 
 def connect_hub(addr: str):
@@ -76,6 +81,14 @@ class CoreClient:
         # thread, so keep them light (print/enqueue)
         self.subscriptions: Dict[str, Any] = {}
         self._closed = False
+        # inbound dispatch table (the hub-side _handlers symmetric):
+        # resolved once here instead of a per-message if/elif chain on
+        # the reader thread
+        self._inbound_handlers = {
+            P.REPLY: self._on_reply,
+            P.PUBSUB_MSG: self._on_pubsub_msg,
+            P.CANCEL_TASK: self._on_cancel_task,
+        }
         self.send(P.HELLO, {"role": role, "worker_id": worker_id,
                             "pid": os.getpid(), "node_id": self.node_id})
         # shm frees anywhere in the cluster invalidate the local wait()
@@ -101,9 +114,9 @@ class CoreClient:
             if self._send_buf:
                 buf, self._send_buf = self._send_buf, []
                 buf.append((msg_type, payload))
-                self.conn.send_bytes(dumps_inline(("batch", buf)))
+                self.conn.send_bytes(dumps_frame(("batch", buf)))
             else:
-                self.conn.send_bytes(dumps_inline((msg_type, payload)))
+                self.conn.send_bytes(dumps_frame((msg_type, payload)))
 
     def send_async(self, msg_type: str, payload: dict) -> None:
         with self._send_lock:
@@ -111,7 +124,7 @@ class CoreClient:
             n = len(self._send_buf)
             if n >= 128:
                 buf, self._send_buf = self._send_buf, []
-                self.conn.send_bytes(dumps_inline(("batch", buf)))
+                self.conn.send_bytes(dumps_frame(("batch", buf)))
                 return
         if n == 1:
             self._buf_evt.set()
@@ -129,7 +142,7 @@ class CoreClient:
                 )
             if self._send_buf:
                 buf, self._send_buf = self._send_buf, []
-                self.conn.send_bytes(dumps_inline(("batch", buf)))
+                self.conn.send_bytes(dumps_frame(("batch", buf)))
 
     def _flush_loop(self) -> None:
         # Catches stray buffered messages ~0.5ms after the burst ends.
@@ -158,7 +171,7 @@ class CoreClient:
                     # treatment; a TypeError in dispatch below is a real bug
                     # and must propagate.
                     raise EOFError("connection closed during recv")
-                msg_type, payload = loads_inline(blob)
+                msg_type, payload = loads_frame(blob)
                 if msg_type == "batch":
                     # hub reactor coalesces its per-peer sends (hub._send)
                     for mt, pl in payload:
@@ -193,44 +206,70 @@ class CoreClient:
                 self._known_ready.pop(oid, None)
 
     def _dispatch_inbound(self, msg_type, payload):
-        if msg_type == P.REPLY:
-            req_id = payload["req_id"]
-            with self._pending_lock:
-                fut = self._pending.pop(req_id, None)
-            if fut is not None:
-                fut.set_result(payload)
-        elif msg_type == P.PUBSUB_MSG:
-            cb = self.subscriptions.get(payload["channel"])
-            if cb is not None:
-                try:
-                    cb(payload["data"])
-                except Exception:
-                    pass
-        elif msg_type == P.CANCEL_TASK:
-            # reader-thread fast path: mark before the executor
-            # dequeues it AND resolve the caller immediately —
-            # the executor may be busy for a long time before it
-            # ever sees the queued message (it drops it silently
-            # at dequeue; a late duplicate TASK_DONE is ignored
-            # because error objects are first-write-wins)
-            self.cancelled_tasks.add(payload["task_id"])
-            if payload.get("return_ids"):
-                blob = dumps_inline(
-                    exceptions.TaskCancelledError("task was cancelled")
-                )
-                self.send(
-                    P.TASK_DONE,
-                    {
-                        "task_id": payload["task_id"],
-                        "returns": [
-                            (oid, P.VAL_ERROR, blob, 0)
-                            for oid in payload["return_ids"]
-                        ],
-                    },
-                )
+        # table dispatch, mirroring the hub's {msg_type: bound_method}
+        # map (built in __init__); anything unrecognized is a task
+        # assignment (worker role) or control message for the executor.
+        h = self._inbound_handlers.get(msg_type)
+        if h is not None:
+            h(payload)
         else:
-            # Task assignment (worker role) or control message.
             self.task_queue.put((msg_type, payload))
+
+    def _on_reply(self, payload):
+        req_id = payload["req_id"]
+        with self._pending_lock:
+            fut = self._pending.pop(req_id, None)
+        if fut is not None:
+            fut.set_result(payload)
+
+    def _on_pubsub_msg(self, payload):
+        cb = self.subscriptions.get(payload["channel"])
+        if cb is None:
+            return
+        # client-published user data rides as an opaque cloudpickle
+        # blob (see publish()); hub-internal channels push plain data
+        blob = payload.get("blob")
+        if blob is not None:
+            try:
+                data = loads_inline(blob)
+            except Exception:
+                # a blob this subscriber can't decode (publisher-only
+                # module etc.) must not kill the reader thread, but
+                # dropping it silently makes the loss undebuggable
+                log_exc(
+                    f"undecodable pubsub blob on channel "
+                    f"{payload.get('channel')!r} (message dropped)"
+                )
+                return
+        else:
+            data = payload["data"]
+        try:
+            cb(data)
+        except Exception:
+            pass
+
+    def _on_cancel_task(self, payload):
+        # reader-thread fast path: mark before the executor
+        # dequeues it AND resolve the caller immediately —
+        # the executor may be busy for a long time before it
+        # ever sees the queued message (it drops it silently
+        # at dequeue; a late duplicate TASK_DONE is ignored
+        # because error objects are first-write-wins)
+        self.cancelled_tasks.add(payload["task_id"])
+        if payload.get("return_ids"):
+            blob = dumps_inline(
+                exceptions.TaskCancelledError("task was cancelled")
+            )
+            self.send(
+                P.TASK_DONE,
+                {
+                    "task_id": payload["task_id"],
+                    "returns": [
+                        (oid, P.VAL_ERROR, blob, 0)
+                        for oid in payload["return_ids"]
+                    ],
+                },
+            )
 
     # Request types safe to retransmit when a reply is slow/lost: reads
     # and idempotent writes. Lost-message tolerance is what the chaos
@@ -646,7 +685,11 @@ class CoreClient:
         self.send(P.SUBSCRIBE, {"channel": channel})
 
     def publish(self, channel: str, data) -> None:
-        self.send_async(P.PUBLISH, {"channel": channel, "data": data})
+        # pre-serialize user data with cloudpickle so the plain-pickle
+        # frame codec never meets a raw __main__-level object; the hub
+        # forwards the blob opaque and the subscriber unwraps it
+        # (_on_pubsub_msg)
+        self.send_async(P.PUBLISH, {"channel": channel, "blob": dumps_inline(data)})
 
     def close(self) -> None:
         if not self._closed:

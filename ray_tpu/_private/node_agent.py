@@ -27,7 +27,7 @@ import sys
 from typing import Dict
 
 from . import protocol as P
-from .serialization import dumps_inline, loads_inline
+from .serialization import dumps_frame, loads_frame
 
 
 def _chip_coords(ntpu: int) -> Dict[int, tuple]:
@@ -88,7 +88,7 @@ class NodeAgent:
         )
 
     def _send(self, msg_type: str, payload: dict) -> None:
-        self.conn.send_bytes(dumps_inline((msg_type, payload)))
+        self.conn.send_bytes(dumps_frame((msg_type, payload)))
 
     # ------------------------------------------------------------------
     def run(self) -> None:
@@ -97,7 +97,7 @@ class NodeAgent:
             while True:
                 if self.conn.poll(1.0):
                     blob = self.conn.recv_bytes()
-                    msg_type, payload = loads_inline(blob)
+                    msg_type, payload = loads_frame(blob)
                     self._handle(msg_type, payload)
                 self._reap()
         except (EOFError, OSError):

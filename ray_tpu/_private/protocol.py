@@ -8,7 +8,13 @@ Bulk data never rides these messages; it goes through the shm object
 store (object_store.py).
 
 Every message is a (msg_type:str, payload:dict) pair encoded with
-serialization.dumps_inline.
+serialization.dumps_frame. Frames carry a one-byte codec marker:
+``b"P"`` (stdlib pickle — the fast path; control frames are dicts of
+primitives/bytes) or ``b"C"`` (cloudpickle — payload blobs, and the
+automatic fallback for any frame stdlib pickle rejects). Both decode
+via serialization.loads_frame. Several messages may be coalesced into
+one ("batch", [(msg_type, payload), ...]) frame by either side
+(client send_async buffering; hub outbox flush).
 """
 
 # client -> hub
@@ -74,7 +80,12 @@ CANCEL_TASK = "cancel_task"  # hub -> worker: drop a queued task
 # pubsub (reference: src/ray/pubsub/ long-poll publisher; here
 # subscribers hold persistent conns so publish is a direct push)
 SUBSCRIBE = "subscribe"      # client -> hub: {channel}
-PUBLISH = "publish"          # client -> hub -> subscribers: {channel, data}
+PUBLISH = "publish"          # client -> hub -> subscribers: {channel, blob}
+                             # blob = dumps_inline(user data) — opaque to
+                             # the hub, unwrapped by the subscriber; only
+                             # hub-INTERNAL publishes use a plain {channel,
+                             # data} body (primitives only — raw user
+                             # objects must never ride a frame unblobbed)
 PUBSUB_MSG = "pubsub_msg"    # hub -> subscriber push
 LOG_RECORD = "log_record"    # worker -> hub: stdout/stderr line batch
 

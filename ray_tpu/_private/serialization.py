@@ -16,6 +16,28 @@ python/ray/_private/serialization.py:122 SerializationContext):
 The wire format is a (header_bytes, [buffer, ...]) pair; buffers can be
 placed into shared memory by the object store for zero-copy cross-process
 transfer.
+
+Frame codec (hub<->client<->agent framing, PR 2): every wire frame
+carries a one-byte marker prefix —
+
+- ``b"P"`` — stdlib pickle. The fast path: control frames are
+  (msg_type, payload-dict) pairs of primitives/bytes, and stdlib
+  pickle's C implementation serializes those ~2x faster than a
+  CloudPickler round. Used by :func:`dumps_frame`.
+- ``b"C"`` — cloudpickle. Used for anything that may capture user
+  objects (:func:`dumps_inline` payload blobs, :func:`dumps_oob`
+  headers), and as the automatic fallback when stdlib pickle raises
+  on a frame (e.g. a ``__main__``-level lambda smuggled into a
+  payload).
+
+Both markers decode with ``pickle.loads`` (cloudpickle output IS
+pickle bytecode); the split exists so the dump side can pick the cheap
+encoder per frame. The ``__main__`` by-reference trap stays
+impossible: arbitrary user values never ride a frame raw — task args
+travel as ``dumps_inline`` blobs (remote_function.encode_args), values
+as ``dumps_oob`` headers, functions as ``dumps_function`` blobs, and
+pubsub data as ``dumps_inline`` blobs (client.publish) — all
+cloudpickle-encoded *before* framing.
 """
 
 from __future__ import annotations
@@ -26,6 +48,11 @@ from typing import Any, List, Tuple
 import cloudpickle
 
 PICKLE5 = 5
+
+# frame markers (see module docstring)
+MARKER_PLAIN = b"P"
+MARKER_CLOUD = b"C"
+_KNOWN_MARKERS = (ord("P"), ord("C"))
 
 
 def dumps_oob(obj: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
@@ -56,9 +83,43 @@ def loads_function(blob: bytes) -> Any:
 
 
 def dumps_inline(obj: Any) -> bytes:
-    """One-shot serialize (no out-of-band buffers) for small control
-    data. cloudpickle for the same by-reference trap as dumps_oob."""
-    return b"C" + cloudpickle.dumps(obj, protocol=PICKLE5)
+    """One-shot serialize (no out-of-band buffers) for payload blobs
+    that may capture arbitrary user objects (task args, error values,
+    pubsub data). cloudpickle for the same by-reference trap as
+    dumps_oob."""
+    return MARKER_CLOUD + cloudpickle.dumps(obj, protocol=PICKLE5)
+
+
+def dumps_frame(obj: Any) -> bytes:
+    """Serialize one wire frame: stdlib pickle fast path with automatic
+    cloudpickle fallback.
+
+    Frames are (msg_type, payload) pairs whose user-facing values are
+    already pre-serialized bytes blobs (module docstring), so stdlib
+    pickle's C encoder handles ~every frame; anything it rejects
+    (a closure/lambda smuggled into a payload) falls back to
+    cloudpickle's by-value treatment instead of failing the send.
+    """
+    try:
+        return MARKER_PLAIN + pickle.dumps(obj, protocol=PICKLE5)
+    except Exception:
+        return MARKER_CLOUD + cloudpickle.dumps(obj, protocol=PICKLE5)
+
+
+def loads_frame(blob: bytes) -> Any:
+    """Decode a frame produced by dumps_frame OR dumps_inline (both
+    markers are pickle bytecode; the marker is validated so a corrupt
+    or unframed blob fails loudly here, not deep inside a handler)."""
+    if not blob or blob[0] not in _KNOWN_MARKERS:
+        raise ValueError(
+            f"bad wire frame: unknown codec marker {blob[:1]!r}"
+        )
+    if len(blob) > 65536:
+        # memoryview spares a full copy of large frames (inline puts
+        # run right up to INLINE_THRESHOLD); for small ones the plain
+        # slice is cheaper than building the view
+        return pickle.loads(memoryview(blob)[1:])
+    return pickle.loads(blob[1:])
 
 
 def loads_inline(blob: bytes) -> Any:

@@ -117,10 +117,34 @@ def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
     if opts.get("max_retries") is not None:
         out["max_retries"] = opts["max_retries"]
     if opts.get("retry_exceptions"):
-        # True = retry any application error; a list/tuple retries only
-        # matching exception types (reference: ray_option_utils semantics)
-        out["retry_exceptions"] = opts["retry_exceptions"]
+        # True = retry any application error; exception type(s) retry
+        # only matching errors (reference: ray_option_utils semantics).
+        # Class objects must not ride the plain-pickle frame codec raw —
+        # a __main__-defined exception class pickles by reference and
+        # fails to resolve in a remote hub — so anything non-bool ships
+        # as a cloudpickle blob (hub._maybe_retry_app_error unwraps it).
+        rex = opts["retry_exceptions"]
+        if not isinstance(rex, bool):
+            rex = _retry_exceptions_blob(rex)
+        out["retry_exceptions"] = rex
     return out
+
+
+# retry_exceptions blob memo: the class list is static per decoration,
+# but scheduling_options runs per .remote() call — without the memo
+# every submit would pay a CloudPickler round (by-value for __main__
+# classes) on the hot path. Keyed by the class tuple itself.
+_REX_BLOB_MEMO: Dict[tuple, bytes] = {}
+
+
+def _retry_exceptions_blob(rex) -> bytes:
+    classes = tuple(rex) if isinstance(rex, (list, tuple)) else (rex,)
+    blob = _REX_BLOB_MEMO.get(classes)
+    if blob is None:
+        if len(_REX_BLOB_MEMO) > 256:
+            _REX_BLOB_MEMO.clear()
+        blob = _REX_BLOB_MEMO[classes] = dumps_inline(classes)
+    return blob
 
 
 def _uploaded_env_uris(client) -> set:

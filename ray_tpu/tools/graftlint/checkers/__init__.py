@@ -9,4 +9,5 @@ from . import (  # noqa: F401
     gl004_remote_misuse,
     gl005_unbounded_accumulator,
     gl006_accumulator_init,
+    gl007_reflection_dispatch,
 )

@@ -1,0 +1,48 @@
+"""bench_core.py harness smoke test (tier-1 safe, not marked slow).
+
+Runs one --smoke micro-iteration of the core microbenchmark end to end
+and asserts the --json report covers every BASELINES metric — so a
+refactor that silently drops a benchmark row (or breaks the harness
+against a runtime change) fails CI instead of being discovered at the
+next perf PR. Numbers are NOT checked: smoke iteration counts are
+sized for latency, not measurement.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench_core.py")
+
+
+def test_smoke_run_reports_every_baseline_metric(tmp_path):
+    out_path = tmp_path / "bench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+    r = subprocess.run(
+        [sys.executable, BENCH, "--smoke", "--json", str(out_path)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=420,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+    data = json.loads(out_path.read_text())
+    assert data["mode"] == "smoke"
+
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from bench_core import BASELINES
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    missing = set(BASELINES) - set(data["metrics"])
+    assert not missing, f"BASELINES metrics missing from report: {missing}"
+    for name, rec in data["metrics"].items():
+        assert rec["value"] > 0, f"{name} reported a non-positive value"
+    # every stdout metric line is one JSON object (the scrapeable form)
+    parsed = [
+        json.loads(line) for line in r.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert {p["metric"] for p in parsed} >= set(BASELINES)

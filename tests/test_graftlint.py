@@ -528,6 +528,111 @@ def test_gl006_clean_zeros_seed_and_non_accumulated_ones():
     assert codes_of(src) == []
 
 
+# --------------------------------------------------------------------- GL007
+
+
+def test_gl007_flags_fstring_getattr_in_while_loop():
+    src = """
+    class Hub:
+        def _run(self):
+            while self._running:
+                msg_type, payload = self.recv()
+                handler = getattr(self, f"_on_{msg_type}", None)
+                if handler is not None:
+                    handler(payload)
+    """
+    assert "GL007" in codes_of(src)
+
+
+def test_gl007_flags_fstring_getattr_in_for_loop():
+    src = """
+    class Hub:
+        def _handle_batch(self, conn, payload):
+            for mt, pl in payload:
+                h = getattr(self, f"_on_{mt}", None)
+                if h is not None:
+                    h(conn, pl)
+    """
+    assert "GL007" in codes_of(src)
+
+
+def test_gl007_flags_concat_percent_and_format_spellings():
+    # the natural revert spellings of the f-string shape must not
+    # slip past the gate
+    for name_expr in (
+        '"_on_" + msg_type',
+        '"_on_%s" % msg_type',
+        '"_on_{}".format(msg_type)',
+    ):
+        src = f"""
+    class Hub:
+        def _run(self):
+            while self._running:
+                msg_type = self.recv()
+                handler = getattr(self, {name_expr}, None)
+    """
+        assert "GL007" in codes_of(src), name_expr
+
+
+def test_gl007_clean_precomputed_name_variable():
+    # passing an already-computed name through getattr in a loop is the
+    # table/probe pattern, not per-message string building
+    src = """
+    def probe(objs, name):
+        out = []
+        for o in objs:
+            out.append(getattr(o, name, None))
+        return out
+    """
+    assert codes_of(src) == []
+
+
+def test_gl007_clean_table_dispatch():
+    # the fixed shape: table built once, dict lookup in the loop
+    src = """
+    class Hub:
+        def __init__(self):
+            self._handlers = {
+                name[4:]: getattr(self, name)
+                for name in dir(type(self))
+                if name.startswith("_on_")
+            }
+
+        def _run(self):
+            while self._running:
+                msg_type, payload = self.recv()
+                handler = self._handlers.get(msg_type)
+                if handler is not None:
+                    handler(payload)
+    """
+    assert codes_of(src) == []
+
+
+def test_gl007_ignores_one_off_reflection_outside_loops():
+    # CLI subcommand resolution: reflection, but not per-message
+    src = """
+    def cmd_list(args, state_api):
+        fn = getattr(state_api, f"list_{args.kind}")
+        return fn()
+    """
+    assert codes_of(src) == []
+
+
+def test_gl007_symbol_is_enclosing_function():
+    src = """
+    class Hub:
+        def _run(self):
+            while True:
+                h = getattr(self, f"_on_{self.recv()}", None)
+    """
+    findings = [
+        f for f in check_file("x.py", source=textwrap.dedent(src))
+        if f.code == "GL007"
+    ]
+    assert len(findings) == 1
+    assert findings[0].symbol == "Hub._run"
+
+
 # ---------------------------------------------------------- infrastructure
 
 
@@ -675,7 +780,7 @@ def test_gl003_nested_coroutine_reported_once():
     assert "inner" in findings[0].symbol
 
 
-# ------------------------------------------------- the four shipped bugs
+# ------------------------------------------------- the shipped bugs
 
 
 def test_reverting_hub_disconnect_fix_is_flagged():
@@ -753,6 +858,27 @@ def test_reverting_multi_agent_deque_fix_is_flagged():
     assert "GL005" in codes_of(src)
 
 
+def test_reverting_hub_dispatch_table_is_flagged():
+    """The PR-2 hot-path fix: hub._handle resolved handlers with
+    getattr(self, f"_on_{mt}") per message inside the batch drain loop;
+    reverting to that shape must trip GL007."""
+    src = """
+    class Hub:
+        def _handle(self, conn, msg_type, payload):
+            if msg_type == "batch":
+                for mt, pl in payload:
+                    h = getattr(self, f"_on_{mt}", None)
+                    if h is not None:
+                        h(conn, pl)
+                return
+            handler = getattr(self, f"_on_{msg_type}", None)
+            if handler is None:
+                return
+            handler(conn, payload)
+    """
+    assert "GL007" in codes_of(src)
+
+
 # ------------------------------------------------------------- repo gate
 
 
@@ -774,4 +900,6 @@ def test_every_checker_is_exercised_by_the_gate_config():
     from ray_tpu.tools.graftlint import all_checkers
 
     codes = {code for code, _name, _fn in all_checkers()}
-    assert codes == {"GL001", "GL002", "GL003", "GL004", "GL005", "GL006"}
+    assert codes == {
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+    }

@@ -1,0 +1,242 @@
+"""Wire codec (PR 2): the two-marker frame format and its guarantees.
+
+Covers the three contracts the fast path must keep:
+
+1. round-trip fidelity over BOTH marker bytes — b"P" (stdlib pickle
+   fast path) and b"C" (cloudpickle, used by payload blobs and as the
+   frame fallback);
+2. total-order preservation when `send` flushes messages buffered by
+   `send_async` (the batch-frame path);
+3. automatic cloudpickle fallback when stdlib pickle rejects a frame
+   (a __main__-level lambda smuggled into a payload must arrive
+   working, not raise at the sender).
+"""
+
+import pickle
+import threading
+
+import pytest
+
+from ray_tpu._private import protocol as P
+from ray_tpu._private.serialization import (
+    MARKER_CLOUD,
+    MARKER_PLAIN,
+    dumps_frame,
+    dumps_inline,
+    loads_frame,
+    loads_inline,
+)
+
+# ------------------------------------------------------------ round trips
+
+# the shapes control frames actually take: dicts of primitives/bytes,
+# nested containers, ids, resource maps, inline value blobs
+PLAIN_PAYLOADS = [
+    ("hello", {"role": "driver", "worker_id": "w" * 28, "pid": 4242}),
+    (
+        "submit_task",
+        {
+            "task_id": b"\x00" * 16,
+            "fn_id": "f" * 40,
+            "args_kind": "inline",
+            "args_payload": b"C" + pickle.dumps(((1, 2), {})),
+            "arg_deps": [b"a" * 16, b"b" * 16],
+            "return_ids": [b"r" * 16],
+            "resources": {"CPU": 1.0, "TPU": 0.0},
+            "options": {"max_retries": 3, "name": None},
+        },
+    ),
+    ("get", {"object_ids": [b"o" * 16] * 100, "timeout": None, "req_id": 7}),
+    ("reply", {"req_id": 7, "values": [(b"o" * 16, "inline", b"x" * 4096)]}),
+    ("batch", [("put", {"object_id": b"p" * 16, "kind": "shm",
+                        "payload": "seg", "size": 2**20})] * 128),
+    ("free", {"object_ids": []}),
+    ("kv_put", {"key": b"k", "value": b"v" * 10_000, "overwrite": True,
+                "req_id": 0}),
+    # > 64 KiB frame: exercises the memoryview (zero-copy) loads branch
+    ("put", {"object_id": b"q" * 16, "kind": "inline",
+             "payload": b"z" * 200_000, "size": 200_000}),
+]
+
+
+@pytest.mark.parametrize("frame", PLAIN_PAYLOADS,
+                         ids=[f[0] + str(i) for i, f in enumerate(PLAIN_PAYLOADS)])
+def test_plain_frames_take_the_fast_path_and_round_trip(frame):
+    blob = dumps_frame(frame)
+    assert blob[:1] == MARKER_PLAIN
+    assert loads_frame(blob) == frame
+
+
+def test_cloudpickle_marker_round_trips_through_loads_frame():
+    # dumps_inline output (payload blobs) must stay decodable by the
+    # frame loader: both markers are pickle bytecode
+    obj = ("msg", {"data": [1, 2, {"k": b"v"}]})
+    blob = dumps_inline(obj)
+    assert blob[:1] == MARKER_CLOUD
+    assert loads_frame(blob) == obj
+    assert loads_inline(blob) == obj
+
+
+def test_loads_frame_rejects_unknown_marker():
+    with pytest.raises(ValueError, match="codec marker"):
+        loads_frame(b"X" + pickle.dumps(("m", {})))
+    with pytest.raises(ValueError, match="codec marker"):
+        loads_frame(b"")
+
+
+def test_main_level_lambda_falls_back_to_cloudpickle():
+    """A closure smuggled into a control payload: stdlib pickle raises
+    at dump time (no importable qualname), so the codec must fall back
+    to cloudpickle's by-value serialization — and the function must
+    arrive runnable."""
+    base = 10
+    smuggled = lambda x: x + base  # noqa: E731
+    smuggled.__module__ = "__main__"  # as if defined in a driver script
+    frame = ("publish", {"channel": "c", "data": {"cb": smuggled}})
+    blob = dumps_frame(frame)
+    assert blob[:1] == MARKER_CLOUD
+    msg_type, payload = loads_frame(blob)
+    assert msg_type == "publish"
+    assert payload["data"]["cb"](32) == 42
+
+
+def test_retry_exceptions_classes_never_ride_a_frame_raw():
+    """A __main__-defined exception class in retry_exceptions pickles by
+    REFERENCE under stdlib pickle (dump succeeds, remote load fails) —
+    so scheduling_options must blob it with cloudpickle before it
+    reaches the frame codec, and the hub must unwrap the blob."""
+    from ray_tpu.remote_function import scheduling_options
+
+    class MyError(Exception):
+        pass
+
+    MyError.__module__ = "__main__"
+    MyError.__qualname__ = "MyError"
+
+    out = scheduling_options({"retry_exceptions": [MyError], "max_retries": 2})
+    rex = out["retry_exceptions"]
+    assert isinstance(rex, bytes) and rex[:1] == MARKER_CLOUD
+    # the whole submit frame stays on the fast path...
+    frame = ("submit_task", {"options": out, "task_id": b"t" * 16})
+    blob = dumps_frame(frame)
+    assert blob[:1] == MARKER_PLAIN
+    # ...and the hub-side unwrap recovers a working class (by value)
+    _mt, payload = loads_frame(blob)
+    (cls,) = loads_inline(payload["options"]["retry_exceptions"])
+    assert issubclass(cls, Exception)
+    assert cls("x").args == ("x",)
+    # a bare class (no list) is blobbed too — as a 1-tuple
+    bare = scheduling_options({"retry_exceptions": MyError})["retry_exceptions"]
+    assert isinstance(bare, bytes) and len(loads_inline(bare)) == 1
+    # the blob is memoized: same class list, same bytes object per submit
+    again = scheduling_options({"retry_exceptions": [MyError]})
+    assert again["retry_exceptions"] is rex
+    # retry_exceptions=True passes through untouched
+    assert scheduling_options({"retry_exceptions": True})["retry_exceptions"] is True
+
+
+def test_exception_instances_round_trip():
+    from ray_tpu.exceptions import ActorDiedError
+
+    blob = dumps_inline(ActorDiedError(msg="Actor is dead."))
+    err = loads_inline(blob)
+    assert isinstance(err, ActorDiedError)
+
+
+# ------------------------------------------------- batch-frame ordering
+
+
+class _FakeConn:
+    """Captures send_bytes frames; recv_bytes blocks until closed (the
+    reader thread parks on it and exits via EOFError on close())."""
+
+    def __init__(self):
+        self.frames = []
+        self._closed = threading.Event()
+
+    def send_bytes(self, blob):
+        self.frames.append(blob)
+
+    def recv_bytes(self):
+        self._closed.wait()
+        raise EOFError
+
+    def close(self):
+        self._closed.set()
+
+
+@pytest.fixture
+def stub_client(tmp_path, monkeypatch):
+    from ray_tpu._private import client as client_mod
+
+    conn = _FakeConn()
+    monkeypatch.setattr(client_mod, "connect_hub", lambda addr: conn)
+    c = client_mod.CoreClient(
+        str(tmp_path / "hub.sock"), str(tmp_path), role="driver",
+        worker_id="w" * 28,
+    )
+    yield c, conn
+    c.close()
+
+
+def _decode_stream(frames):
+    """Flatten captured frames into the total (msg_type, payload) order
+    the hub would observe."""
+    out = []
+    for blob in frames:
+        msg_type, payload = loads_frame(blob)
+        if msg_type == "batch":
+            out.extend(payload)
+        else:
+            out.append((msg_type, payload))
+    return out
+
+
+def test_send_flushes_buffered_async_messages_in_order(stub_client):
+    client, conn = stub_client
+    start = len(conn.frames)
+    for i in range(5):
+        client.send_async("put", {"seq": i})
+    client.send("get", {"seq": 5})  # must flush the 5 buffered puts first
+    msgs = _decode_stream(conn.frames[start:])
+    assert [m[0] for m in msgs] == ["put"] * 5 + ["get"]
+    assert [m[1]["seq"] for m in msgs] == list(range(6))
+    # every frame on the wire took the fast path
+    assert all(f[:1] == MARKER_PLAIN for f in conn.frames)
+
+
+def test_send_async_flushes_full_batches_in_order(stub_client):
+    client, conn = stub_client
+    start = len(conn.frames)
+    for i in range(300):  # crosses the 128-message batch threshold twice
+        client.send_async("put", {"seq": i})
+    client.flush()
+    msgs = _decode_stream(conn.frames[start:])
+    assert [m[1]["seq"] for m in msgs] == list(range(300))
+
+
+def test_inbound_dispatch_table_routes_reply_and_pubsub(stub_client):
+    client, _conn = stub_client
+    got = []
+    client.subscriptions["chan"] = got.append
+
+    fut_payload = {"req_id": 123, "ok": True}
+    from concurrent.futures import Future
+
+    fut = Future()
+    with client._pending_lock:
+        client._pending[123] = fut
+    client._dispatch_inbound(P.REPLY, fut_payload)
+    assert fut.result(timeout=1) == fut_payload
+
+    # blob-wrapped pubsub (client.publish path) unwraps before the callback
+    client._dispatch_inbound(
+        P.PUBSUB_MSG, {"channel": "chan", "blob": dumps_inline({"x": 1})}
+    )
+    # hub-internal plain-data pubsub still works
+    client._dispatch_inbound(P.PUBSUB_MSG, {"channel": "chan", "data": [4, 2]})
+    assert got == [{"x": 1}, [4, 2]]
+
+    # unknown types land on the executor queue
+    client._dispatch_inbound("exec_task", {"task_id": b"t"})
+    assert client.task_queue.get_nowait() == ("exec_task", {"task_id": b"t"})
