@@ -27,6 +27,10 @@ _DEFAULTS: Dict[str, Any] = {
     "memory_usage_threshold": 0.0,           # bytes/worker; 0 = disabled
     # observability
     "task_events_max": 20000,
+    "runtime_events_max": 2000,          # flight-recorder ring size
+    "builtin_metrics": True,             # ray_tpu_* runtime self-metrics
+    "node_heartbeat_period_s": 2.0,      # per-node gauge cadence; 0 = off
+    "flight_recorder_path": "",          # "" = <session_dir>/flight_recorder.json
     # test hooks
     "chaos_drop": "",
 }

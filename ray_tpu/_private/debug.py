@@ -17,3 +17,16 @@ def log_exc(prefix: str) -> None:
     ``[ray_tpu]`` banner. For broad-``except`` arms where raising is
     not an option and losing the traceback is worse."""
     sys.stderr.write(f"[ray_tpu] {prefix}:\n{traceback.format_exc()}\n")
+
+
+def proc_rss_bytes(pid: int) -> int:
+    """Resident set size of a live process, 0 if unreadable (process
+    gone, or no /proc). Shared by the hub's memory monitor and the
+    hub/agent heartbeat samplers."""
+    import os
+
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+    except (OSError, IndexError, ValueError):
+        return 0

@@ -44,7 +44,7 @@ from multiprocessing.connection import Listener
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import protocol as P
-from .debug import log_exc
+from .debug import log_exc, proc_rss_bytes
 from .ids import WorkerID
 from .serialization import (
     dumps_frame,
@@ -415,6 +415,16 @@ class Hub:
         # observability plane (reference: stats/metric.h registry +
         # core_worker/task_event_buffer.h -> GCS task events)
         self.metrics: Dict[Tuple[str, tuple], dict] = {}
+        # flight recorder: bounded structured log of runtime events
+        # (node up/down, worker exits, retries, spills, stream failures
+        # ...) for post-mortem debugging — the built-in replacement for
+        # grepping stderr, per "Collective Communication for 100k+
+        # GPUs" (arxiv 2510.20171): at pod scale a bounded in-memory
+        # recorder dumped on crash is what makes failures debuggable.
+        # Exposed as list_state("events"), `ray_tpu events`, dashboard
+        # /api/events, and dump_flight_recorder() on fatal error.
+        self.events: deque = deque(maxlen=int(self.config.runtime_events_max))
+        self._event_seq = itertools.count()
         self.task_events: deque = deque(maxlen=int(self.config.task_events_max))
         self._task_event_index: Dict[bytes, dict] = {}
         # user/library tracing spans (reference: ray.util.tracing's
@@ -447,6 +457,17 @@ class Hub:
         # re-arms the fd and the burst continues next wake (bounded
         # fairness, not starvation). 256 = two full client batches.
         self._drain_budget = 256
+        # builtin runtime metrics (ray_tpu_* namespace) record straight
+        # into self.metrics — the hub IS the registry, so no RPC to
+        # itself (reference: src/ray/stats/metric_defs.cc ray_* series
+        # from every component). Gated: RAY_TPU_BUILTIN_METRICS=0 drops
+        # the per-message timing AND keeps the registry clean.
+        self._builtin_metrics = bool(self.config.builtin_metrics)
+        # per-msg-type (counter, latency histogram) entries, cached so
+        # the dispatch hot path pays one dict lookup, not registry math
+        self._msg_metrics: Dict[str, tuple] = {}
+        self._node_gauges: Dict[str, tuple] = {}
+        self._seed_builtin_metrics()
         self._shutdown_evt = threading.Event()
         self.thread = threading.Thread(target=self._run, daemon=True, name="ray-tpu-hub")
 
@@ -472,6 +493,8 @@ class Hub:
             return
         outbox, self._outbox = self._outbox, {}
         for conn, msgs in outbox.items():
+            self._bm_flushes["value"] += 1
+            self._bm_observe(self._bm_flush_size, float(len(msgs)))
             try:
                 if len(msgs) == 1:
                     conn.send_bytes(dumps_frame(msgs[0]))
@@ -497,9 +520,43 @@ class Hub:
             self._add_timer(
                 self.config.memory_monitor_period_s, self._memory_monitor
             )
+        if self.config.node_heartbeat_period_s > 0:
+            self._add_timer(
+                self.config.node_heartbeat_period_s, self._head_heartbeat
+            )
+        self._record_event("hub_start", addr=self.addr)
         sel = self._selector = selectors.DefaultSelector()
         lsock = self.listener._listener._socket  # raw fd for readiness polling
         sel.register(lsock, selectors.EVENT_READ, None)  # data=None => accept
+        try:
+            self._reactor_loop(sel)
+        except Exception:
+            # anything escaping the per-connection guards is fatal to
+            # the control plane: capture the post-mortem before the
+            # session's state evaporates with this thread
+            log_exc("hub reactor FATAL error")
+            try:
+                path = self.dump_flight_recorder("fatal_reactor_error")
+                sys.stderr.write(f"[ray_tpu] flight recorder dumped to {path}\n")
+            except Exception:
+                log_exc("flight recorder dump failed")
+        # teardown
+        for w in self.workers.values():
+            self._kill_worker(w)
+        for conn in list(self.agent_conns):
+            self._send(conn, P.KILL, {})
+        self._flush_outbox()
+        try:
+            self.listener.close()
+        except Exception:
+            pass
+        try:
+            sel.close()
+        except Exception:
+            pass
+        self._shutdown_evt.set()
+
+    def _reactor_loop(self, sel) -> None:
         while self._running:
             now = time.monotonic()
             while self.timers and self.timers[0][0] <= now:
@@ -513,6 +570,7 @@ class Hub:
             if self.timers:
                 timeout = max(0.0, self.timers[0][0] - time.monotonic())
             events = sel.select(timeout)
+            self._bm_wakeups["value"] += 1
             for key, _mask in events:
                 conn = key.data
                 if conn is None:
@@ -544,7 +602,11 @@ class Hub:
                         # charging it as 1 would let one peer hold the
                         # reactor for 128x the intended fairness bound
                         budget -= len(payload) if msg_type == "batch" else 1
-                        if budget <= 0 or not conn.poll(0):
+                        if budget <= 0:
+                            if conn.poll(0):
+                                self._bm_drain_sat["value"] += 1
+                            break
+                        if not conn.poll(0):
                             break
                     self._flush_outbox()
                 except (EOFError, OSError):
@@ -555,24 +617,218 @@ class Hub:
                     # client in the session hangs if this loop dies
                     log_exc("hub reactor error (dropping conn)")
                     self._safe_disconnect(conn)
-        # teardown
-        for w in self.workers.values():
-            self._kill_worker(w)
-        for conn in list(self.agent_conns):
-            self._send(conn, P.KILL, {})
-        self._flush_outbox()
-        try:
-            self.listener.close()
-        except Exception:
-            pass
-        try:
-            sel.close()
-        except Exception:
-            pass
-        self._shutdown_evt.set()
+
+    def _head_heartbeat(self) -> None:
+        """Self-sample the head node's gauges (remote hosts report the
+        same numbers via node-agent heartbeats, _on_node_heartbeat)."""
+        head = self.nodes.get("node0")
+        if head is not None:
+            rss = self._worker_rss(os.getpid()) + sum(
+                self._worker_rss(w.proc.pid)
+                for w in self.workers.values()
+                if w.proc is not None and w.node_id == "node0"
+            )
+            try:
+                load = os.getloadavg()[0]
+            except OSError:
+                load = 0.0
+            self._node_stat_gauges(
+                "node0",
+                rss_bytes=float(rss),
+                cpu_load_1m=load,
+                n_workers=float(sum(
+                    1 for w in self.workers.values() if w.node_id == "node0"
+                )),
+            )
+            self._bm_store_gauge(head)
+        self._add_timer(self.config.node_heartbeat_period_s, self._head_heartbeat)
+
+    def _node_stat_gauges(self, node_id: str, **stats: float) -> None:
+        tags = (("node_id", node_id),)
+        for name, value in stats.items():
+            self._bm(f"ray_tpu_node_{name}", "gauge",
+                     "node-agent heartbeat stat", tags)["value"] = value
+
+    def _on_node_heartbeat(self, conn, p):
+        node = self.nodes.get(p.get("node_id", ""))
+        if node is None or not node.alive:
+            return
+        self._node_stat_gauges(
+            node.node_id,
+            rss_bytes=float(p.get("rss_bytes", 0.0)),
+            cpu_load_1m=float(p.get("cpu_load_1m", 0.0)),
+            n_workers=float(p.get("n_workers", 0.0)),
+        )
+        self._bm_store_gauge(node)
 
     def _add_timer(self, delay: float, cb):
         heapq.heappush(self.timers, (time.monotonic() + delay, next(self._timer_seq), cb))
+
+    # ------------------------------------------- builtin runtime metrics
+    # handler latencies are tens of µs; placement can take seconds when
+    # a worker must spawn; flush sizes are message counts
+    _LATENCY_BOUNDS = (50e-6, 200e-6, 1e-3, 5e-3, 25e-3, 0.1, 1.0)
+    _PLACEMENT_BOUNDS = (1e-3, 5e-3, 25e-3, 0.1, 0.5, 2.0, 10.0)
+    _FLUSH_BOUNDS = (1.0, 4.0, 16.0, 64.0, 128.0, 512.0)
+
+    def _bm(self, name: str, mtype: str, description: str = "",
+            tags: tuple = (), boundaries: tuple = ()) -> dict:
+        """Get-or-create a builtin registry entry — the same dict shape
+        _on_metric_record aggregates into, so builtin series ride the
+        existing snapshot()/prometheus_text()/dashboard surfaces for
+        free. With builtin metrics disabled the entry is a detached
+        dict: update paths stay branch-free, the registry stays clean."""
+        if not self._builtin_metrics:
+            return {"name": name, "type": mtype, "description": description,
+                    "tags": tags, "value": 0.0, "sum": 0.0, "count": 0,
+                    "buckets": [[b, 0] for b in boundaries]}
+        key = (name, tags)
+        m = self.metrics.get(key)
+        if m is None:
+            m = self.metrics[key] = {
+                "name": name, "type": mtype, "description": description,
+                "tags": tags, "value": 0.0, "sum": 0.0, "count": 0,
+                "buckets": [[b, 0] for b in boundaries],
+            }
+        return m
+
+    @staticmethod
+    def _bm_observe(m: dict, value: float) -> None:
+        m["sum"] += value
+        m["count"] += 1
+        for pair in m["buckets"]:
+            if value <= pair[0]:
+                pair[1] += 1
+                break
+
+    def _seed_builtin_metrics(self) -> None:
+        """Pre-register the untagged builtin series (and cache direct
+        entry references for the hot paths) so a scrape sees the full
+        catalog at zero even before the first increment."""
+        bm = self._bm
+        self._bm_wakeups = bm(
+            "ray_tpu_hub_reactor_wakeups_total", "counter",
+            "reactor selector wake-ups")
+        self._bm_drain_sat = bm(
+            "ray_tpu_hub_drain_budget_saturated_total", "counter",
+            "bursts cut off by the per-peer drain budget with input "
+            "still pending")
+        self._bm_flushes = bm(
+            "ray_tpu_hub_outbox_flushes_total", "counter",
+            "per-peer outbox flushes (one frame each)")
+        self._bm_flush_size = bm(
+            "ray_tpu_hub_outbox_flush_messages", "histogram",
+            "messages coalesced per outbox flush",
+            boundaries=self._FLUSH_BOUNDS)
+        self._bm_queue_depth = bm(
+            "ray_tpu_scheduler_queue_depth", "gauge",
+            "runnable tasks queued across scheduling classes")
+        self._bm_placement = bm(
+            "ray_tpu_scheduler_placement_latency_seconds", "histogram",
+            "submit-to-dispatch latency", boundaries=self._PLACEMENT_BOUNDS)
+        self._bm_placed = bm(
+            "ray_tpu_scheduler_tasks_placed_total", "counter",
+            "tasks dispatched to a worker")
+        self._bm_spawns = bm(
+            "ray_tpu_scheduler_worker_spawns_total", "counter",
+            "worker processes spawned")
+        self._bm_task_fail = bm(
+            "ray_tpu_tasks_failed_total", "counter",
+            "tasks failed past their retry budget")
+        self._bm_task_retry = bm(
+            "ray_tpu_tasks_retried_total", "counter",
+            "task retries (worker death or retry_exceptions)")
+        self._bm_spills = bm(
+            "ray_tpu_object_store_spilled_total", "counter",
+            "shm segments spilled to disk")
+        self._bm_restores = bm(
+            "ray_tpu_object_store_restored_total", "counter",
+            "spilled segments restored to shm")
+        self._bm_credit_stalls = bm(
+            "ray_tpu_stream_credit_stalls_total", "counter",
+            "streaming-generator producers parked on backpressure credit")
+        self._bm_events_total = bm(
+            "ray_tpu_events_total", "counter",
+            "flight-recorder events recorded")
+
+    def _bm_store_gauge(self, node: NodeEntry) -> None:
+        g = self._node_gauges.get(node.node_id)
+        if g is None:
+            tags = (("node_id", node.node_id),)
+            g = self._node_gauges[node.node_id] = (
+                self._bm("ray_tpu_object_store_bytes", "gauge",
+                         "live shm segment bytes", tags),
+                self._bm("ray_tpu_node_chips_in_use", "gauge",
+                         "TPU chips not in the node's free pool", tags),
+            )
+        g[0]["value"] = node.store_used
+        g[1]["value"] = float(
+            node.total.get("TPU", 0.0)
+        ) - len(node.free_tpu_chips)
+
+    # ------------------------------------------------ flight recorder
+    def _record_event(self, kind: str, **fields) -> None:
+        ev = {"seq": next(self._event_seq), "ts": time.time(), "kind": kind}
+        ev.update(fields)
+        self.events.append(ev)
+        self._bm_events_total["value"] += 1
+
+    def _flight_doc(self, reason: str) -> dict:
+        return {
+            "reason": reason,
+            "dumped_at": time.time(),
+            # copy every row: json.dump runs AFTER the retry window, so
+            # handing it live dicts the reactor still mutates would
+            # reintroduce the mid-iteration crash the retry guards
+            "events": [dict(e) for e in self.events],
+            "metrics": [
+                dict(m, tags=[list(t) for t in m["tags"]],
+                     buckets=[list(b) for b in m["buckets"]])
+                for m in list(self.metrics.values())
+            ],
+            "nodes": [
+                {"node_id": n.node_id, "alive": n.alive, "ip": n.ip,
+                 "resources": dict(n.total), "available": dict(n.avail),
+                 "store_used": n.store_used}
+                for n in list(self.nodes.values())
+            ],
+            "workers": [
+                {"worker_id": w.worker_id, "state": w.state,
+                 "node_id": w.node_id,
+                 "pid": w.proc.pid if w.proc else None}
+                for w in list(self.workers.values())
+            ],
+            "tasks": [dict(e) for e in list(self.task_events)[-200:]],
+        }
+
+    def dump_flight_recorder(self, reason: str = "manual") -> str:
+        """Write events + registry + cluster tables to disk for
+        post-mortem (called on reactor fatal error and head SIGTERM;
+        RAY_TPU_FLIGHT_RECORDER_PATH overrides the session-dir default).
+
+        Callable from any thread: the reactor keeps mutating these
+        structures while a SIGTERM handler or driver snapshots them, so
+        a mid-iteration resize (RuntimeError) is retried — losing the
+        post-mortem exactly when the system is busy defeats its point."""
+        import json as _json
+
+        path = (self.config.get("flight_recorder_path") or "").strip()
+        if not path:
+            path = os.path.join(self.session_dir, "flight_recorder.json")
+        for attempt in range(4):
+            try:
+                doc = self._flight_doc(reason)
+                break
+            except RuntimeError:
+                if attempt == 3:
+                    raise
+                time.sleep(0.05)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            _json.dump(doc, f, default=str)
+        os.replace(tmp, path)
+        return path
 
     # -------------------------------------------------------------- dispatch
     def _handle(self, conn, msg_type: str, payload):
@@ -585,18 +841,36 @@ class Hub:
 
             prob = self._chaos.get(msg_type)
             if prob and random.random() < prob:
+                self._record_event("chaos_drop", msg_type=msg_type)
                 return  # injected message drop
-        handlers = self._handlers
         if msg_type == "batch":
             for mt, pl in payload:
-                h = handlers.get(mt)
-                if h is not None:
-                    h(conn, pl)
+                self._dispatch_msg(conn, mt, pl)
             return
-        handler = handlers.get(msg_type)
+        self._dispatch_msg(conn, msg_type, payload)
+
+    def _dispatch_msg(self, conn, msg_type: str, payload) -> None:
+        handler = self._handlers.get(msg_type)
         if handler is None:
             return
+        if not self._builtin_metrics:
+            handler(conn, payload)
+            return
+        mm = self._msg_metrics.get(msg_type)
+        if mm is None:
+            tags = (("type", msg_type),)
+            mm = self._msg_metrics[msg_type] = (
+                self._bm("ray_tpu_hub_messages_total", "counter",
+                         "messages handled, by type", tags),
+                self._bm("ray_tpu_hub_handler_latency_seconds", "histogram",
+                         "handler wall time, by message type", tags,
+                         self._LATENCY_BOUNDS),
+            )
+        t0 = time.perf_counter()
         handler(conn, payload)
+        dt = time.perf_counter() - t0
+        mm[0]["value"] += 1
+        self._bm_observe(mm[1], dt)
 
     def _ordered_nodes(self) -> List[NodeEntry]:
         """Alive nodes, head first (the hybrid policy's prefer-local)."""
@@ -664,6 +938,10 @@ class Hub:
         )
         self.nodes[node.node_id] = node
         self.agent_conns[conn] = node.node_id
+        self._record_event(
+            "node_up", node_id=node.node_id, hostname=node.hostname,
+            ip=node.ip, resources=dict(node.total),
+        )
         self._reply(conn, p["req_id"], ok=True)
         self._dispatch()
 
@@ -680,6 +958,10 @@ class Hub:
             sys.stderr.write(
                 f"[ray_tpu] worker {w.worker_id} on {w.node_id} exited with "
                 f"code {p.get('code')} before connecting\n"
+            )
+            self._record_event(
+                "worker_spawn_failed", worker_id=w.worker_id,
+                node_id=w.node_id, code=p.get("code"),
             )
             self.workers.pop(w.worker_id, None)
             self._dispatch()
@@ -769,6 +1051,7 @@ class Hub:
         lru[oid] = e.size
         lru.move_to_end(oid)
         self._maybe_spill(node)
+        self._bm_store_gauge(node)
 
     def _touch_segment(self, oid: bytes, e: ObjEntry):
         lru = self._lru.get(e.node_id)
@@ -783,6 +1066,7 @@ class Hub:
                 node = self.nodes.get(e.node_id)
                 if node is not None:
                     node.store_used = max(0.0, node.store_used - size)
+                    self._bm_store_gauge(node)
 
     def _maybe_spill(self, node: NodeEntry):
         if node.store_cap <= 0 or node.store_used <= node.store_cap:
@@ -804,6 +1088,11 @@ class Hub:
             if e is None or e.spilled:
                 continue
             e.spilled = True
+            self._bm_spills["value"] += 1
+            self._record_event(
+                "spill", object_id=oid.hex(), size=e.size,
+                node_id=node.node_id,
+            )
             if node.agent_conn is None:
                 os.makedirs(self.spill_dir, exist_ok=True)
                 src = os.path.join(node.session_dir, "objects", e.payload)
@@ -1092,6 +1381,7 @@ class Hub:
                 self._send(node.agent_conn, P.OBJ_RESTORE, {"name": e.payload})
                 e.spilled = False
             if not e.spilled:
+                self._bm_restores["value"] += 1
                 self._account_segment(p["object_id"], e)
         offset = p.get("offset")
         length = p.get("length")
@@ -1207,7 +1497,13 @@ class Hub:
     def _on_stream_end(self, conn, p):
         s = self._stream(p["task_id"])
         if p.get("error") is not None:
-            self._task_event(p["task_id"], state="FAILED")
+            self._task_event(p["task_id"], state="FAILED",
+                             finished_at=time.time(),
+                             t_finished=time.monotonic())
+            self._record_event(
+                "stream_failure", task_id=p["task_id"].hex(),
+                yielded=len(s.oids),
+            )
             # the N+1-th ref carries the error (reference semantics)
             from .ids import ObjectID
 
@@ -1261,6 +1557,7 @@ class Hub:
         if s.consumed >= p["min_consumed"] or s.ended:
             self._reply(conn, p["req_id"], ok=True)
         else:
+            self._bm_credit_stalls["value"] += 1
             s.credit_waiters.append((p["min_consumed"], conn, p["req_id"]))
 
     def _wake_credit_waiters(self, s: StreamEntry, force: bool = False):
@@ -1289,8 +1586,21 @@ class Hub:
                 "value": 0.0,
                 "sum": 0.0,
                 "count": 0,
-                "buckets": [[b, 0] for b in p.get("boundaries", ())],
+                # defensively re-sort: first-match bucketing below is
+                # only correct on ascending boundaries (the Histogram
+                # constructor validates, but raw senders bypass it)
+                "buckets": [[b, 0] for b in sorted(p.get("boundaries", ()))],
             }
+        elif m["type"] != p["type"]:
+            # first-wins: the record still lands in the original entry
+            # (unchanged semantics), but the conflict is no longer
+            # silent — one flight-recorder event per (name, tags) key
+            if not m.get("type_conflict"):
+                m["type_conflict"] = True
+                self._record_event(
+                    "metric_type_conflict", name=p["name"],
+                    registered=m["type"], attempted=p["type"],
+                )
         op = p["op"]
         if op == "add":
             m["value"] += p["value"]
@@ -1306,7 +1616,7 @@ class Hub:
 
     # ----- task events (reference: core_worker/task_event_buffer.h;
     # feeds list_state("tasks") + the chrome-trace timeline)
-    def _task_event(self, task_id: bytes, **fields):
+    def _task_event(self, task_id: bytes, **fields) -> dict:
         ev = self._task_event_index.get(task_id)
         if ev is None:
             ev = {"task_id": task_id.hex()}
@@ -1321,6 +1631,7 @@ class Hub:
                     next(iter(self._task_event_index))
                 )
         ev.update(fields)
+        return ev
 
     # ----- pubsub (reference: src/ray/pubsub/publisher.h:300 — here a
     # direct push over the subscriber's persistent connection)
@@ -1411,10 +1722,14 @@ class Hub:
                 self.dep_waiters.setdefault(dep, []).append(spec)
         spec.deps_remaining = pending
         self.tasks[spec.task_id] = spec
+        # lifecycle stamps: wall clocks (submitted_at/...) are display
+        # timestamps for the timeline; the t_* monotonic twins are what
+        # durations (queue wait, run time) are computed from — wall
+        # deltas step with NTP (graftlint GL008 guards the distinction)
         self._task_event(
             spec.task_id, name=spec.fn_id or (spec.method or ""),
             state="PENDING_ARGS" if pending else "PENDING_SCHEDULING",
-            submitted_at=time.time(),
+            submitted_at=time.time(), t_submit=time.monotonic(),
         )
         if pending == 0:
             self._enqueue_runnable(spec)
@@ -1431,6 +1746,11 @@ class Hub:
         if q is None:
             q = self.runnable[key] = deque()
         q.append(spec)
+        # deps resolved: the task is now scheduler-visible (a retry
+        # re-stamps, so the breakdown reflects the latest attempt)
+        ev = self._task_event_index.get(spec.task_id)
+        if ev is not None:
+            ev["t_queued"] = time.monotonic()
         self._dispatch()
 
     def _resources_fit(self, need: Dict[str, float], avail: Dict[str, float]) -> bool:
@@ -1543,6 +1863,9 @@ class Hub:
         for key in empty_keys:
             if not self.runnable.get(key):
                 self.runnable.pop(key, None)
+        self._bm_queue_depth["value"] = float(
+            sum(len(q) for q in self.runnable.values())
+        )
         # spawn workers where placement deferred for lack of an idle
         # worker. max_workers caps the POOLED task-worker count; actor
         # creations always get a process (actors pin workers for life —
@@ -1731,10 +2054,19 @@ class Hub:
         worker.state = "busy"
         worker.current_task = spec
         worker.tpu_chips = chips
-        self._task_event(
+        now_mono = time.monotonic()
+        ev = self._task_event(
             spec.task_id, state="RUNNING", started_at=time.time(),
+            t_scheduled=now_mono,
             worker_id=worker.worker_id, node_id=worker.node_id,
         )
+        self._bm_placed["value"] += 1
+        # measure from the LATEST queue entry (retries re-stamp
+        # t_queued), falling back to submit — a retry of a 10s task
+        # must not record a 10s "placement"
+        t0 = ev.get("t_queued") or ev.get("t_submit")
+        if t0 is not None:
+            self._bm_observe(self._bm_placement, now_mono - t0)
         fn_blob = None
         if spec.fn_id not in worker.seen_fns:
             fn_blob = self.functions.get(spec.fn_id)
@@ -1778,6 +2110,7 @@ class Hub:
 
         wid = WorkerID.generate().hex()
         node.spawning += 1
+        self._bm_spawns["value"] += 1
         if for_actor:
             node.spawning_actor += 1
         renv_json = _json.dumps(runtime_env) if runtime_env else ""
@@ -1832,6 +2165,10 @@ class Hub:
                 f"[ray_tpu] worker {w.worker_id} exited with code {w.proc.returncode} "
                 f"before connecting\n"
             )
+            self._record_event(
+                "worker_spawn_failed", worker_id=w.worker_id,
+                node_id=w.node_id, code=w.proc.returncode,
+            )
             node = self.nodes.get(w.node_id)
             if node is not None:
                 node.spawning = max(0, node.spawning - 1)
@@ -1842,12 +2179,7 @@ class Hub:
             self._dispatch()
         self._add_timer(self.config.worker_reap_period_s, self._reap_workers)
 
-    def _worker_rss(self, pid: int) -> int:
-        try:
-            with open(f"/proc/{pid}/statm") as f:
-                return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
-        except (OSError, IndexError, ValueError):
-            return 0
+    _worker_rss = staticmethod(proc_rss_bytes)
 
     def _memory_monitor(self):
         """Kill local workers whose RSS exceeds the per-worker cap
@@ -1868,6 +2200,11 @@ class Hub:
             sys.stderr.write(
                 f"[ray_tpu] memory monitor: worker {victim.worker_id} rss "
                 f"exceeds {cap:.0f} bytes; killing\n"
+            )
+            self._record_event(
+                "oom_kill", worker_id=victim.worker_id,
+                node_id=victim.node_id,
+                rss=self._worker_rss(victim.proc.pid), cap=cap,
             )
             spec = victim.current_task
             if spec is not None:
@@ -1917,10 +2254,18 @@ class Hub:
             any(kind == P.VAL_ERROR for _, kind, _, _ in p["returns"])
             or prev_ev.get("state") == "FAILED"
         )
-        self._task_event(
+        ev = self._task_event(
             p["task_id"], state="FAILED" if failed else "FINISHED",
-            finished_at=time.time(),
+            finished_at=time.time(), t_finished=time.monotonic(),
         )
+        if failed:
+            # application error published to the caller (retries, if
+            # any, were already consumed or not requested)
+            self._bm_task_fail["value"] += 1
+            self._record_event(
+                "task_failed", task_id=p["task_id"].hex(),
+                name=ev.get("name", ""),
+            )
         for oid, kind, payload, size in p["returns"]:
             self._object_ready(oid, kind, payload, size, node_id=node_id)
         self._dispatch()
@@ -1966,6 +2311,11 @@ class Hub:
         spec.retries_left -= 1
         self.tasks[spec.task_id] = spec
         self._task_event(spec.task_id, state="PENDING_RETRY")
+        self._bm_task_retry["value"] += 1
+        self._record_event(
+            "task_retry", task_id=spec.task_id.hex(), reason="app_error",
+            retries_left=spec.retries_left,
+        )
         self._enqueue_runnable(spec)
         return True
 
@@ -1994,7 +2344,12 @@ class Hub:
         if spec.options.get("streaming"):
             self._end_stream_with_error(spec.task_id, blob)
         self._task_event(spec.task_id, state="FAILED", finished_at=time.time(),
-                         error=str(err)[:200])
+                         t_finished=time.monotonic(), error=str(err)[:200])
+        self._bm_task_fail["value"] += 1
+        self._record_event(
+            "task_give_up", task_id=spec.task_id.hex(),
+            name=spec.fn_id or (spec.method or ""), error=str(err)[:200],
+        )
         self.tasks.pop(spec.task_id, None)
         self._unpin_deps(spec)
 
@@ -2045,6 +2400,10 @@ class Hub:
         if p.get("error") is not None:
             # constructor raised: actor is dead on arrival
             actor.state = "dead"
+            self._task_event(
+                p["actor_id"], state="FAILED",
+                finished_at=time.time(), t_finished=time.monotonic(),
+            )
             if spec is not None:
                 self._release_task_resources(spec)
                 self._unpin_deps(spec)
@@ -2057,6 +2416,10 @@ class Hub:
             return
         actor.state = "alive"
         actor.worker_id = wid
+        self._task_event(
+            p["actor_id"], state="FINISHED",
+            finished_at=time.time(), t_finished=time.monotonic(),
+        )
         # the creation spec is finalized but its arg pins must survive
         # for the actor's lifetime (restart replays the creation args):
         # transfer them to the actor entry. A restart's respawn spec
@@ -2108,6 +2471,11 @@ class Hub:
                 self.dep_waiters.setdefault(dep, []).append(spec)
         spec.deps_remaining = pending
         spec.options["_actor_call"] = True
+        self._task_event(
+            spec.task_id, name=spec.method or "",
+            state="PENDING_ARGS" if pending else "PENDING_ACTOR",
+            submitted_at=time.time(), t_submit=time.monotonic(),
+        )
         if pending:
             self.tasks[spec.task_id] = spec
             return
@@ -2127,7 +2495,8 @@ class Hub:
         actor.inflight[spec.task_id] = spec
         self._task_event(
             spec.task_id, name=spec.method or "", state="RUNNING",
-            started_at=time.time(), worker_id=worker.worker_id,
+            started_at=time.time(), t_scheduled=time.monotonic(),
+            worker_id=worker.worker_id,
             node_id=worker.node_id, actor_id=actor.actor_id.hex(),
         )
         self._send(
@@ -2275,7 +2644,12 @@ class Hub:
         if wid is None:
             if conn is self.driver_conn:
                 # driver died: shut the whole session down
+                self._record_event("driver_disconnect")
                 self._running = False
+            else:
+                # a remote client (Ray Client parity) going away is a
+                # normal-but-notable event: its pending gets died with it
+                self._record_event("client_disconnect")
             return
         worker = self.workers.pop(wid, None)
         if worker is None:
@@ -2296,6 +2670,20 @@ class Hub:
         node.spawning = 0
         node.spawning_actor = 0
         sys.stderr.write(f"[ray_tpu] node {node_id} died\n")
+        self._record_event(
+            "node_down", node_id=node_id, hostname=node.hostname,
+            workers=sum(1 for w in self.workers.values()
+                        if w.node_id == node_id),
+        )
+        # zero the dead node's gauges: a scrape must not keep showing
+        # last-heartbeat RSS/load for a host that no longer exists
+        self._node_stat_gauges(
+            node_id, rss_bytes=0.0, cpu_load_1m=0.0, n_workers=0.0,
+        )
+        g = self._node_gauges.get(node_id)
+        if g is not None:
+            g[0]["value"] = 0.0  # store bytes
+            g[1]["value"] = 0.0  # chips in use
         self._fail_fetches_for_node(node_id)
         self._dispatch()
 
@@ -2303,6 +2691,12 @@ class Hub:
         from ..exceptions import ActorDiedError, WorkerCrashedError
 
         worker.state = "dead"
+        self._record_event(
+            "worker_exit", worker_id=worker.worker_id,
+            node_id=worker.node_id,
+            actor_id=worker.actor_id.hex() if worker.actor_id else None,
+            mid_task=worker.current_task is not None,
+        )
         self.workers.pop(worker.worker_id, None)
         self.conn_to_worker.pop(worker.conn, None)
         wnode = self.nodes.get(worker.node_id)
@@ -2331,6 +2725,11 @@ class Hub:
                     f"({self.config.memory_usage_threshold:.0f} bytes)"))
             elif spec.retries_left > 0:
                 spec.retries_left -= 1
+                self._bm_task_retry["value"] += 1
+                self._record_event(
+                    "task_retry", task_id=spec.task_id.hex(),
+                    reason="worker_died", retries_left=spec.retries_left,
+                )
                 self._enqueue_runnable(spec)
             else:
                 self._fail_task(spec, WorkerCrashedError("worker died while executing task"))
@@ -2363,6 +2762,10 @@ class Hub:
                         actor.restarts_left -= 1
                     actor.state = "restarting"
                     actor.worker_id = None
+                    self._record_event(
+                        "actor_restart", actor_id=actor.actor_id.hex(),
+                        name=actor.name, restarts_left=actor.restarts_left,
+                    )
                     # in-flight calls fail; queued calls run on the new incarnation
                     blob = dumps_inline(ActorDiedError(msg="Actor died; call was in flight."))
                     for s in actor.inflight.values():
@@ -2764,27 +3167,58 @@ class Hub:
                 })
         elif kind == "tasks":
             items = list(self.task_events)
+        elif kind == "events":
+            items = list(self.events)
         elif kind == "metrics":
             for m in self.metrics.values():
                 items.append(dict(m, buckets=[list(b) for b in m["buckets"]]))
         elif kind == "timeline":
             # chrome://tracing "complete" events (reference: ray.timeline
-            # via GCS task events -> chrome trace)
+            # via GCS task events -> chrome trace). Wall stamps position
+            # the slices; durations come from the monotonic t_* twins
+            # (GL008: a wall-clock delta is not a duration).
+            now_mono = time.monotonic()
             for ev in self.task_events:
                 if "started_at" not in ev:
                     continue
-                end = ev.get("finished_at") or time.time()
+                t_sched = ev.get("t_scheduled")
+                t_fin = ev.get("t_finished")
+                dur_s = 0.0
+                if t_sched is not None:
+                    dur_s = (t_fin if t_fin is not None else now_mono) - t_sched
                 items.append({
                     "name": ev.get("name", ""),
                     "cat": "task",
                     "ph": "X",
                     "ts": ev["started_at"] * 1e6,
-                    "dur": max(0.0, (end - ev["started_at"]) * 1e6),
+                    "dur": max(0.0, dur_s * 1e6),
                     "pid": ev.get("node_id", "node0"),
                     "tid": ev.get("worker_id", ""),
                     "args": {"task_id": ev["task_id"],
                              "state": ev.get("state")},
                 })
+                # state-transition slice: the queued phase rendered
+                # alongside the run slice so a saturated scheduler is
+                # visible at a glance. Same fallback chain as the
+                # placement metric and summarize_tasks: retries
+                # re-stamp t_queued, and the first attempt's RUN time
+                # must not render as the retry's queue wait. The slice
+                # is end-aligned to the dispatch moment (started_at).
+                t0 = ev.get("t_queued") or ev.get("t_submit")
+                if (t0 is not None and t_sched is not None
+                        and "submitted_at" in ev):
+                    qdur = max(0.0, (t_sched - t0) * 1e6)
+                    items.append({
+                        "name": f"{ev.get('name', '')} [queued]",
+                        "cat": "task_state",
+                        "ph": "X",
+                        "ts": ev["started_at"] * 1e6 - qdur,
+                        "dur": qdur,
+                        "pid": ev.get("node_id", "node0"),
+                        "tid": ev.get("worker_id", ""),
+                        "args": {"task_id": ev["task_id"],
+                                 "transition": "SUBMITTED->RUNNING"},
+                    })
             for sp in self.spans:
                 items.append({
                     "name": sp.get("name", ""),

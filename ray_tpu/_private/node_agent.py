@@ -92,7 +92,15 @@ class NodeAgent:
 
     # ------------------------------------------------------------------
     def run(self) -> None:
+        import time
+
         signal.signal(signal.SIGTERM, lambda *a: self._shutdown())
+        # same config knob the hub's head self-sampler reads, so both
+        # sides of the cluster heartbeat at one cadence
+        from .config import RAY_TPU_CONFIG
+
+        hb_period = float(RAY_TPU_CONFIG.node_heartbeat_period_s)
+        last_hb = 0.0
         try:
             while True:
                 if self.conn.poll(1.0):
@@ -100,10 +108,37 @@ class NodeAgent:
                     msg_type, payload = loads_frame(blob)
                     self._handle(msg_type, payload)
                 self._reap()
+                now = time.monotonic()
+                if hb_period > 0 and now - last_hb >= hb_period:
+                    last_hb = now
+                    self._heartbeat()
         except (EOFError, OSError):
             pass  # hub gone: tear down
         finally:
             self._shutdown()
+
+    def _heartbeat(self) -> None:
+        """Report this host's vitals; the hub turns them into
+        ray_tpu_node_* gauges (reference: raylet resource reports
+        carried on heartbeats, node_manager.cc ReportResourceUsage)."""
+        from .debug import proc_rss_bytes
+
+        rss = proc_rss_bytes(os.getpid()) + sum(
+            proc_rss_bytes(p.pid) for p in self.children.values()
+        )
+        try:
+            load = os.getloadavg()[0]
+        except OSError:
+            load = 0.0
+        self._send(
+            P.NODE_HEARTBEAT,
+            {
+                "node_id": self.node_id,
+                "rss_bytes": rss,
+                "cpu_load_1m": load,
+                "n_workers": len(self.children),
+            },
+        )
 
     def _handle(self, msg_type: str, p) -> None:
         if msg_type == "batch":

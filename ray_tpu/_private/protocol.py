@@ -55,6 +55,7 @@ STREAM_CREDIT = "stream_credit"  # worker -> hub: backpressure wait
 # node agent <-> hub (multi-host: one agent per host, reference analogue
 # src/ray/raylet/node_manager.h:122 registering with the GCS)
 REGISTER_NODE = "register_node"
+NODE_HEARTBEAT = "node_heartbeat"  # agent -> hub: cpu/rss/worker gauges
 SPAWN_WORKER = "spawn_worker"      # hub -> agent: fork a worker process
 WORKER_EXITED = "worker_exited"    # agent -> hub: child died pre-connect
 OBJ_READ = "obj_read"              # hub -> agent: read a shm segment

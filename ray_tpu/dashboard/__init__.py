@@ -8,7 +8,8 @@ chrome-trace timeline, and job submission/inspection:
     GET  /api/cluster_status     nodes + aggregate resources
     GET  /api/nodes|actors|tasks|workers|objects|placement_groups
     GET  /api/timeline           chrome://tracing JSON
-    GET  /metrics                Prometheus text
+    GET  /api/events             flight-recorder runtime events
+    GET  /metrics                Prometheus text (user + ray_tpu_* builtin)
     GET  /api/jobs               job table
     POST /api/jobs               {"entrypoint": ...} -> {"job_id": ...}
     GET  /api/jobs/{id}          status
@@ -63,7 +64,7 @@ class Dashboard:
             kind = request.match_info["kind"]
             allowed = {
                 "nodes", "actors", "tasks", "workers", "objects",
-                "placement_groups",
+                "placement_groups", "events",
             }
             if kind not in allowed:
                 raise web.HTTPNotFound(text=f"unknown kind {kind}")

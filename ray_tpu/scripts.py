@@ -97,6 +97,22 @@ def cmd_start(args) -> None:
         print(f"ray_tpu head started at {addr}")
         print("connect with: ray_tpu.init(address=" + repr(addr) + ")")
         print("stop with: python -m ray_tpu stop")
+
+        def _on_sigterm(signum, frame):
+            # post-mortem before dying: dump the flight recorder (the
+            # hub thread is still alive here), then reuse the Ctrl-C
+            # teardown path below
+            from ray_tpu._private import worker as _worker
+
+            if _worker._hub is not None:
+                try:
+                    path = _worker._hub.dump_flight_recorder("sigterm")
+                    print(f"flight recorder dumped to {path}", flush=True)
+                except Exception:
+                    pass
+            raise KeyboardInterrupt
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
         # Head blocks for its lifetime (reference: ray start --block; a
         # non-blocking daemonizing head adds nothing on one host where
         # drivers embed the hub in-process anyway).
@@ -220,6 +236,35 @@ def cmd_summary(args) -> None:
     print(json.dumps(fn(), indent=2, default=str))
 
 
+def cmd_events(args) -> None:
+    """Flight-recorder runtime events (node up/down, worker exits,
+    retries, spills...; reference: `ray list cluster-events`)."""
+    from ray_tpu.util import state as state_api
+
+    _connect(args)
+    events = state_api.list_events()
+    if args.kind:
+        events = [e for e in events if e.get("kind") == args.kind]
+    if args.format == "json":
+        print(json.dumps(events, indent=2, default=str))
+        return
+    rows = []
+    for e in events:
+        detail = " ".join(
+            f"{k}={v}" for k, v in e.items()
+            if k not in ("seq", "ts", "kind")
+        )
+        rows.append({
+            "seq": e.get("seq", ""),
+            "time": time.strftime(
+                "%H:%M:%S", time.localtime(e.get("ts", 0))
+            ),
+            "kind": e.get("kind", ""),
+            "detail": detail[:120],
+        })
+    _print_table(rows, ["seq", "time", "kind", "detail"])
+
+
 def cmd_timeline(args) -> None:
     import ray_tpu
 
@@ -336,6 +381,13 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("kind", choices=["tasks", "actors", "objects"])
     add_address(sp)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("events", help="flight-recorder runtime events")
+    sp.add_argument("--kind", default=None,
+                    help="filter by event kind (e.g. node_down)")
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("timeline", help="dump chrome://tracing timeline")
     sp.add_argument("--output", default=None)

@@ -10,4 +10,5 @@ from . import (  # noqa: F401
     gl005_unbounded_accumulator,
     gl006_accumulator_init,
     gl007_reflection_dispatch,
+    gl008_wall_clock_duration,
 )

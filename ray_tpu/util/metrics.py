@@ -103,7 +103,23 @@ class Histogram(Metric):
         tag_keys: Optional[Sequence[str]] = None,
     ):
         super().__init__(name, description, tag_keys)
-        self.boundaries = list(boundaries or _DEFAULT_HIST_BOUNDARIES)
+        bounds = [float(b) for b in (boundaries or _DEFAULT_HIST_BOUNDARIES)]
+        # the registry buckets observations by FIRST boundary >= value in
+        # list order, which is only a histogram if boundaries ascend; and
+        # Prometheus le="..." labels assume positive finite bounds. An
+        # unsorted list used to mis-bucket silently.
+        if not bounds:
+            raise ValueError("Histogram boundaries must be non-empty")
+        if any(b <= 0 for b in bounds):
+            raise ValueError(
+                f"Histogram boundaries must be positive, got {bounds}"
+            )
+        if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+            raise ValueError(
+                "Histogram boundaries must be sorted ascending with no "
+                f"duplicates, got {bounds}"
+            )
+        self.boundaries = bounds
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
         self._record(value, tags, "observe", boundaries=tuple(self.boundaries))
@@ -122,6 +138,44 @@ def snapshot() -> List[Dict]:
     return worker.get_client().list_state("metrics")
 
 
+def _sanitize_name(name: str) -> str:
+    """Clamp a metric name to the exposition-format charset
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (invalid runs become ``_``)."""
+    import re
+
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not name or not re.match(r"[a-zA-Z_:]", name[0]):
+        name = "_" + name
+    return name
+
+
+def _sanitize_label_name(name: str) -> str:
+    """Label names are stricter than metric names: no ``:`` allowed
+    (``[a-zA-Z_][a-zA-Z0-9_]*``)."""
+    import re
+
+    name = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if not name or not re.match(r"[a-zA-Z_]", name[0]):
+        name = "_" + name
+    return name
+
+
+def _escape_label(value) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double-quote, and newline must be escaped or a crafted tag value
+    breaks (or injects) series in the scrape."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def prometheus_text() -> str:
     """Render the registry in Prometheus exposition format (the
     reference exports via its metrics agent to Prometheus; here the
@@ -129,15 +183,18 @@ def prometheus_text() -> str:
     lines: List[str] = []
     seen_help = set()
     for m in snapshot():
-        name = m["name"]
+        name = _sanitize_name(m["name"])
         if name not in seen_help:
             seen_help.add(name)
             if m.get("description"):
-                lines.append(f"# HELP {name} {m['description']}")
+                lines.append(f"# HELP {name} {_escape_help(m['description'])}")
             kind = {"counter": "counter", "gauge": "gauge",
                     "histogram": "histogram"}.get(m["type"], "untyped")
             lines.append(f"# TYPE {name} {kind}")
-        labels = ",".join(f'{k}="{v}"' for k, v in m["tags"])
+        labels = ",".join(
+            f'{_sanitize_label_name(k)}="{_escape_label(v)}"'
+            for k, v in m["tags"]
+        )
         suffix = "{" + labels + "}" if labels else ""
         if m["type"] == "histogram":
             acc = 0

@@ -35,6 +35,11 @@ def list_objects(filters: Optional[list] = None) -> List[dict]:
 def list_placement_groups(filters: Optional[list] = None) -> List[dict]:
     return _apply_filters(_client().list_state("placement_groups"), filters)
 
+def list_events(filters: Optional[list] = None) -> List[dict]:
+    """Flight-recorder runtime events (node up/down, worker exits,
+    retries, spills, ...) — the hub's bounded post-mortem log."""
+    return _apply_filters(_client().list_state("events"), filters)
+
 
 def _apply_filters(items: List[dict], filters: Optional[list]) -> List[dict]:
     """filters: [(key, "=" | "!=", value), ...] (reference filter shape)."""
@@ -54,18 +59,47 @@ def _apply_filters(items: List[dict], filters: Optional[list]) -> List[dict]:
     return out
 
 
+def _percentiles(values: List[float]) -> Optional[Dict[str, float]]:
+    """Nearest-rank p50/p95/p99 — small-n friendly, no numpy needed."""
+    if not values:
+        return None
+    vs = sorted(values)
+
+    def rank(p: float) -> float:
+        return vs[min(len(vs) - 1, int(round(p / 100.0 * (len(vs) - 1))))]
+
+    return {"p50": rank(50), "p95": rank(95), "p99": rank(99),
+            "max": vs[-1], "count": len(vs)}
+
+
 def summarize_tasks() -> Dict[str, Any]:
-    """Counts by state and by function (reference: summarize_tasks)."""
+    """Counts by state and by function, plus the lifecycle latency
+    breakdown (reference: summarize_tasks): queue-wait is submit ->
+    dispatch-to-worker, run-time is dispatch -> done, both computed
+    from the hub's monotonic t_* stamps."""
     events = _client().list_state("tasks")
     by_state = Counter(e.get("state", "UNKNOWN") for e in events)
     by_func: Dict[str, Counter] = {}
+    queue_waits: List[float] = []
+    run_times: List[float] = []
     for e in events:
         name = (e.get("name") or "unknown").split(":")[0]
         by_func.setdefault(name, Counter())[e.get("state", "UNKNOWN")] += 1
+        # queue wait starts at the LATEST queue entry (retries re-stamp
+        # t_queued; actor calls have no queued phase and fall back to
+        # t_submit) so the breakdown reflects the final attempt
+        t0 = e.get("t_queued") or e.get("t_submit")
+        t_sched, t_fin = e.get("t_scheduled"), e.get("t_finished")
+        if t0 is not None and t_sched is not None:
+            queue_waits.append(max(0.0, t_sched - t0))
+        if t_sched is not None and t_fin is not None:
+            run_times.append(max(0.0, t_fin - t_sched))
     return {
         "total": len(events),
         "by_state": dict(by_state),
         "by_func_name": {k: dict(v) for k, v in by_func.items()},
+        "queue_wait_s": _percentiles(queue_waits),
+        "run_time_s": _percentiles(run_times),
     }
 
 

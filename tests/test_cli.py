@@ -67,6 +67,41 @@ def test_status_and_list(cli_cluster):
     assert "(none)" in out or "ACTOR_ID" in out
 
 
+def test_events_and_summary_tasks(cli_cluster):
+    env, addr, _head = cli_cluster
+    # drive a tiny workload through a job so there are task events
+    out = _cli(
+        env, "job", "submit", "--wait", "--",
+        sys.executable, "-c",
+        "import os, ray_tpu; "
+        "ray_tpu.init(address=os.environ['RAY_TPU_ADDRESS']); "
+        "f = ray_tpu.remote(lambda: 1); "
+        "print(sum(ray_tpu.get([f.remote() for _ in range(3)])))",
+        timeout=120,
+    )
+    assert "SUCCEEDED" in out
+
+    out = _cli(env, "summary", "tasks")
+    summary = json.loads(out)
+    assert summary["total"] >= 1
+    assert "by_state" in summary
+    # the lifecycle breakdown rides the same summary
+    assert "queue_wait_s" in summary and "run_time_s" in summary
+    if summary["run_time_s"]:
+        assert {"p50", "p95", "p99"} <= set(summary["run_time_s"])
+
+    out = _cli(env, "events", "--format", "json")
+    events = json.loads(out)
+    assert isinstance(events, list) and events
+    assert all("kind" in e and "seq" in e for e in events)
+    assert any(e["kind"] == "hub_start" for e in events)
+    # table mode renders without blowing up, and the filter narrows
+    out = _cli(env, "events")
+    assert "KIND" in out
+    out = _cli(env, "events", "--kind", "hub_start", "--format", "json")
+    assert all(e["kind"] == "hub_start" for e in json.loads(out))
+
+
 def test_job_submit_wait_logs(cli_cluster):
     env, addr, _head = cli_cluster
     out = _cli(

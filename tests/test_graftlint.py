@@ -633,6 +633,101 @@ def test_gl007_symbol_is_enclosing_function():
     assert findings[0].symbol == "Hub._run"
 
 
+# --------------------------------------------------------------------- GL008
+
+_PRIV = "ray_tpu/_private/fixture.py"
+
+
+def test_gl008_flags_wall_clock_delta():
+    # the classic stamp-and-subtract duration, spelled with time.time()
+    src = """
+    import time
+
+    def handle(self, msg):
+        t0 = time.time()
+        self.dispatch(msg)
+        self.latency.observe(time.time() - t0)
+    """
+    assert "GL008" in codes_of(src, path=_PRIV)
+
+
+def test_gl008_flags_from_import_spelling():
+    src = """
+    from time import time
+
+    def run(self):
+        start = time()
+        self.step()
+        return time() - start
+    """
+    assert "GL008" in codes_of(src, path=_PRIV)
+
+
+def test_gl008_clean_monotonic_duration():
+    src = """
+    import time
+
+    def handle(self, msg):
+        t0 = time.monotonic()
+        self.dispatch(msg)
+        self.latency.observe(time.monotonic() - t0)
+    """
+    assert codes_of(src, path=_PRIV) == []
+
+
+def test_gl008_clean_mtime_comparison():
+    # file mtimes ARE wall clock: comparing them against time.time()
+    # is the only correct spelling (runtime-env stale-lock breaker)
+    src = """
+    import os
+    import time
+
+    def stale(lock):
+        return time.time() - os.path.getmtime(lock) > 300
+    """
+    assert codes_of(src, path=_PRIV) == []
+
+
+def test_gl008_clean_mtime_through_local_name():
+    # provenance tracks through locals symmetrically: an mtime stored
+    # in a variable still exempts the subtraction
+    src = """
+    import os
+    import time
+
+    def stale(lock):
+        stamped = os.path.getmtime(lock)
+        now = time.time()
+        return now - stamped > 300
+    """
+    assert codes_of(src, path=_PRIV) == []
+
+
+def test_gl008_clean_wall_timestamp_without_delta():
+    # absolute wall stamps (timeline positions, usage reports) are fine
+    src = """
+    import time
+
+    def stamp(ev):
+        ev["submitted_at"] = time.time()
+        ev["ms"] = int(time.time() * 1000)
+    """
+    assert codes_of(src, path=_PRIV) == []
+
+
+def test_gl008_only_applies_to_private():
+    # user-facing spans/timelines legitimately carry wall timestamps
+    src = """
+    import time
+
+    def span():
+        t0 = time.time()
+        return time.time() - t0
+    """
+    assert codes_of(src, path="ray_tpu/util/tracing.py") == []
+    assert "GL008" in codes_of(src, path=_PRIV)
+
+
 # ---------------------------------------------------------- infrastructure
 
 
@@ -879,6 +974,28 @@ def test_reverting_hub_dispatch_table_is_flagged():
     assert "GL007" in codes_of(src)
 
 
+def test_reverting_hub_timeline_wall_duration_is_flagged():
+    """The PR-4 lifecycle fix: timeline slice durations used to come
+    from wall-clock stamp deltas (`end = finished_at or time.time()`,
+    `end - started_at`); durations now subtract the monotonic t_*
+    twins. Reverting to the wall-delta shape must trip GL008."""
+    src = """
+    import time
+
+    class Hub:
+        def _on_list_state(self, conn, p):
+            items = []
+            for ev in self.task_events:
+                end = ev.get("finished_at") or time.time()
+                items.append({
+                    "ts": ev["started_at"] * 1e6,
+                    "dur": max(0.0, (end - ev["started_at"]) * 1e6),
+                })
+            return items
+    """
+    assert "GL008" in codes_of(src, path="ray_tpu/_private/hub.py")
+
+
 # ------------------------------------------------------------- repo gate
 
 
@@ -902,4 +1019,5 @@ def test_every_checker_is_exercised_by_the_gate_config():
     codes = {code for code, _name, _fn in all_checkers()}
     assert codes == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
+        "GL008",
     }

@@ -1,0 +1,310 @@
+"""Runtime self-instrumentation: builtin ray_tpu_* hub/scheduler
+metrics, the task-lifecycle latency breakdown, and the flight recorder
+(list_state("events"), dashboard /api/events, crash dump)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics
+from ray_tpu.util import state as state_api
+
+
+def _wait_for(cond, timeout=10):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _run_small_workload():
+    @ray_tpu.remote
+    def bump(x):
+        return x + 1
+
+    assert ray_tpu.get([bump.remote(i) for i in range(8)]) == list(range(1, 9))
+    ref = ray_tpu.put({"k": "v"})
+    assert ray_tpu.get(ref) == {"k": "v"}
+
+
+# ------------------------------------------------------- builtin metrics
+def test_builtin_metrics_present_after_workload(ray_start_regular):
+    _run_small_workload()
+
+    def enough():
+        names = {
+            m["name"] for m in metrics.snapshot()
+            if m["name"].startswith("ray_tpu_")
+        }
+        return len(names) >= 10
+
+    assert _wait_for(enough), sorted(
+        {m["name"] for m in metrics.snapshot()}
+    )
+    snap = metrics.snapshot()
+    by_name = {}
+    for m in snap:
+        by_name.setdefault(m["name"], []).append(m)
+    # the acceptance floor: >= 10 distinct builtin series in the scrape
+    builtin = [n for n in by_name if n.startswith("ray_tpu_")]
+    assert len(builtin) >= 10, builtin
+    # per-msg-type counters actually counted the workload's traffic
+    submit = [
+        m for m in by_name["ray_tpu_hub_messages_total"]
+        if ("type", "submit_task") in m["tags"]
+    ]
+    assert submit and submit[0]["value"] >= 8
+    # and the latency histogram observed the same messages
+    lat = [
+        m for m in by_name["ray_tpu_hub_handler_latency_seconds"]
+        if ("type", "submit_task") in m["tags"]
+    ]
+    assert lat and lat[0]["count"] >= 8 and lat[0]["sum"] > 0
+    placed = by_name["ray_tpu_scheduler_tasks_placed_total"][0]
+    assert placed["value"] >= 8
+    # everything renders through the one prometheus surface
+    text = metrics.prometheus_text()
+    prom_names = {
+        line.split("{")[0].split(" ")[0]
+        for line in text.splitlines()
+        if line.startswith("ray_tpu_")
+    }
+    assert len(prom_names) >= 10, prom_names
+
+
+def test_builtin_node_gauges_from_heartbeat(ray_start_regular):
+    """The head self-samples the same gauges node agents report."""
+    _run_small_workload()
+
+    def gauges_up():
+        snap = {
+            m["name"]: m for m in metrics.snapshot()
+            if m["name"].startswith("ray_tpu_node_")
+        }
+        return (
+            snap.get("ray_tpu_node_rss_bytes", {}).get("value", 0) > 0
+            and "ray_tpu_node_n_workers" in snap
+            and "ray_tpu_node_chips_in_use" in snap
+        )
+
+    # heartbeat cadence is 2s; first sample lands within one period
+    assert _wait_for(gauges_up, timeout=15), [
+        m["name"] for m in metrics.snapshot()
+    ]
+
+
+# -------------------------------------------------- lifecycle breakdown
+def test_summarize_tasks_latency_percentiles(ray_start_regular):
+    @ray_tpu.remote
+    def snooze():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([snooze.remote() for _ in range(4)])
+
+    def done():
+        s = state_api.summarize_tasks()
+        return (s["run_time_s"] or {}).get("count", 0) >= 4
+
+    assert _wait_for(done), state_api.summarize_tasks()
+    s = state_api.summarize_tasks()
+    qw, rt = s["queue_wait_s"], s["run_time_s"]
+    for block in (qw, rt):
+        assert block["p50"] <= block["p95"] <= block["p99"] <= block["max"]
+        assert block["p50"] >= 0.0
+    assert rt["p50"] >= 0.05  # the sleep is inside the run phase
+    # raw monotonic stamps ride the task events themselves
+    ev = next(
+        e for e in state_api.list_tasks()
+        if e.get("state") == "FINISHED" and e.get("name", "").startswith("snooze")
+    )
+    assert ev["t_submit"] <= ev["t_queued"] <= ev["t_scheduled"] <= ev["t_finished"]
+
+
+def test_timeline_renders_queued_state_slices(ray_start_regular):
+    @ray_tpu.remote
+    def work():
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    assert _wait_for(
+        lambda: any(
+            e.get("cat") == "task_state" for e in ray_tpu.timeline()
+        )
+    )
+    tl = ray_tpu.timeline()
+    queued = [e for e in tl if e.get("cat") == "task_state"]
+    assert queued and all(e["ph"] == "X" for e in queued)
+    assert all(e["name"].endswith("[queued]") for e in queued)
+    assert all(e["args"]["transition"] == "SUBMITTED->RUNNING" for e in queued)
+
+
+# --------------------------------------------------- flight recorder
+def test_flight_recorder_basic_events(ray_start_regular):
+    _run_small_workload()
+    events = state_api.list_events()
+    assert events, "hub_start should always be recorded"
+    assert events[0]["kind"] == "hub_start"
+    for e in events:
+        assert {"seq", "ts", "kind"} <= set(e)
+    # task give-up lands in the recorder
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise RuntimeError("x")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    assert _wait_for(
+        lambda: any(
+            e["kind"] == "task_failed" for e in state_api.list_events()
+        )
+    ), state_api.list_events()
+
+
+def test_metric_type_conflict_records_event(ray_start_regular):
+    c = metrics.Counter("dup_series_metric")
+    c.inc(3)
+    assert _wait_for(
+        lambda: any(
+            m["name"] == "dup_series_metric" for m in metrics.snapshot()
+        )
+    )
+    g = metrics.Gauge("dup_series_metric")
+    g.set(99)
+    assert _wait_for(
+        lambda: any(
+            e["kind"] == "metric_type_conflict"
+            and e["name"] == "dup_series_metric"
+            for e in state_api.list_events()
+        )
+    ), state_api.list_events()
+    # first-wins: the entry keeps its original type
+    m = next(
+        m for m in metrics.snapshot() if m["name"] == "dup_series_metric"
+    )
+    assert m["type"] == "counter"
+
+
+def test_flight_recorder_dump(ray_start_regular, tmp_path):
+    _run_small_workload()
+    from ray_tpu._private import worker as _worker
+
+    path = _worker._hub.dump_flight_recorder("test")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "test"
+    assert {"events", "metrics", "nodes", "workers", "tasks"} <= set(doc)
+    assert any(e["kind"] == "hub_start" for e in doc["events"])
+    assert any(
+        m["name"].startswith("ray_tpu_") for m in doc["metrics"]
+    )
+    assert doc["nodes"][0]["node_id"] == "node0"
+
+
+def test_node_death_lands_in_flight_recorder(shutdown_only):
+    """The acceptance-criteria scenario: an induced node death must be
+    reconstructable from list_state("events") alone."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_num_cpus=2)
+    try:
+        node = cluster.add_node(num_cpus=1, resources={"doomed": 1.0})
+        assert _wait_for(
+            lambda: any(
+                e["kind"] == "node_up" and e["node_id"] == node.node_id
+                for e in state_api.list_events()
+            )
+        ), state_api.list_events()
+        cluster.remove_node(node)
+        assert _wait_for(
+            lambda: any(
+                e["kind"] == "node_down" and e["node_id"] == node.node_id
+                for e in state_api.list_events()
+            )
+        ), state_api.list_events()
+        down = next(
+            e for e in state_api.list_events()
+            if e["kind"] == "node_down" and e["node_id"] == node.node_id
+        )
+        assert down["ts"] > 0 and "hostname" in down
+    finally:
+        cluster.shutdown()
+
+
+# ------------------------------------------------------- metrics bugfixes
+def test_histogram_rejects_bad_boundaries():
+    for bad in ([1.0, 0.5, 2.0], [0.5, 0.5, 1.0], [-1.0, 1.0], [0.0, 1.0]):
+        with pytest.raises(ValueError):
+            metrics.Histogram("h", boundaries=bad)
+    # sorted positive boundaries still construct
+    h = metrics.Histogram("h", boundaries=[0.1, 1.0, 10.0])
+    assert h.boundaries == [0.1, 1.0, 10.0]
+
+
+def test_prometheus_escaping_and_name_sanitization(ray_start_regular):
+    c = metrics.Counter("weird metric-name", description="d", tag_keys=("q",))
+    c.inc(1, tags={"q": 'a"b\\c\nd'})
+    assert _wait_for(
+        lambda: any(
+            m["name"] == "weird metric-name" for m in metrics.snapshot()
+        )
+    )
+    text = metrics.prometheus_text()
+    # names clamp to [a-zA-Z_:][a-zA-Z0-9_:]*
+    assert "weird_metric_name" in text
+    assert "weird metric-name" not in text
+    # label values escape backslash, quote, and newline
+    assert 'q="a\\"b\\\\c\\nd"' in text
+    assert "\nd\"" not in text  # the raw newline must not survive
+    # label NAMES are stricter than metric names: no ':' allowed
+    g = metrics.Gauge("colon_gauge", tag_keys=("app:env",))
+    g.set(1.0, tags={"app:env": "prod"})
+    assert _wait_for(
+        lambda: any(m["name"] == "colon_gauge" for m in metrics.snapshot())
+    )
+    text = metrics.prometheus_text()
+    assert 'colon_gauge{app_env="prod"}' in text
+    assert "app:env=" not in text
+
+
+def test_prometheus_no_raw_newlines_in_series(ray_start_regular):
+    g = metrics.Gauge("nl_gauge", tag_keys=("t",))
+    g.set(1.0, tags={"t": "line1\nline2"})
+    assert _wait_for(
+        lambda: any(m["name"] == "nl_gauge" for m in metrics.snapshot())
+    )
+    for line in metrics.prometheus_text().splitlines():
+        if line.startswith("nl_gauge"):
+            assert 'line1\\nline2' in line
+
+
+# ------------------------------------------------------------ dashboard
+def test_dashboard_metrics_timeline_events_endpoints(ray_start_regular):
+    import urllib.request
+
+    from ray_tpu.dashboard import Dashboard
+
+    _run_small_workload()
+    dash = Dashboard(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{dash.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            body = r.read().decode()
+        assert "ray_tpu_hub_messages_total" in body
+        with urllib.request.urlopen(base + "/api/timeline", timeout=10) as r:
+            assert r.status == 200
+            tl = json.loads(r.read())
+        assert isinstance(tl, list) and all(e["ph"] == "X" for e in tl)
+        with urllib.request.urlopen(base + "/api/events", timeout=10) as r:
+            assert r.status == 200
+            events = json.loads(r.read())
+        assert isinstance(events, list) and events
+        assert all("kind" in e and "ts" in e and "seq" in e for e in events)
+        assert any(e["kind"] == "hub_start" for e in events)
+    finally:
+        dash.stop()
