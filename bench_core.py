@@ -36,6 +36,12 @@ BASELINES = {
     "single_client_tasks_sync": 963.0,
     "single_client_tasks_async": 7293.0,
     "multi_client_tasks_async": 22747.0,
+    # net-new row (no reference analogue): two client processes
+    # submitting concurrently under distinct REGISTERED tenants, so the
+    # fairsched ordering/accounting path is on. Baseline measured on
+    # this repo's 2-vCPU CI box at the row's introduction (PR 5), not
+    # on the m4.16xlarge the reference rows came from.
+    "scheduler_contention": 3150.0,
     "1_1_actor_calls_sync": 2043.0,
     "1_1_actor_calls_async": 8120.0,
     "1_1_actor_calls_concurrent": 5396.0,
@@ -127,11 +133,14 @@ def main() -> None:
     # (ray_perf.py Client actor / work() tasks), not driver threads.
     @ray_tpu.remote
     class Client:
-        def __init__(self, targets=None):
+        def __init__(self, targets=None, tenant=None):
             self.targets = targets or []
+            # scheduler_contention row: each submitting client stamps
+            # its own tenant so the hub's fairsched path does real work
+            self.fn = nullary.options(tenant=tenant) if tenant else nullary
 
         def task_batch(self, n):
-            ray_tpu.get([nullary.remote() for _ in range(n)])
+            ray_tpu.get([self.fn.remote() for _ in range(n)])
             return n
 
         def call_batch(self, n):
@@ -334,6 +343,28 @@ def main() -> None:
         return n
 
     report("placement_group_create_removal", timeit(pg_churn, warmup=0), "pg/s")
+
+    # ---- multi-tenant scheduler contention (LAST: registering tenants
+    # turns the fairsched accounting path on for the rest of the
+    # session, and the single-tenant rows above must stay inert-path)
+    # Two client processes submit concurrently under distinct
+    # registered tenants, so quota admission + fair-share class
+    # ordering + usage accounting all run on the dispatch hot path.
+    from ray_tpu._private import worker as _worker
+
+    _bench_client = _worker.get_client()
+    _bench_client.register_job("bench-job-a", tenant="bench-a")
+    _bench_client.register_job("bench-job-b", tenant="bench-b")
+    contention = [Client.remote(tenant=f"bench-{t}") for t in ("a", "b")]
+    ray_tpu.get([c.task_batch.remote(4) for c in contention])
+
+    def sched_contention():
+        ray_tpu.get(
+            [c.task_batch.remote(N_ASYNC // 2) for c in contention]
+        )
+        return N_ASYNC
+
+    report("scheduler_contention", timeit(sched_contention), "tasks/s")
 
     ray_tpu.shutdown()
 

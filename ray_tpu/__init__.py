@@ -33,6 +33,7 @@ from ._private.worker import (
     wait,
 )
 from .actor import ActorClass, ActorHandle
+from .job_config import JobConfig
 from .object_ref import ObjectRef, ObjectRefGenerator
 from .remote_function import RemoteFunction
 from .runtime_context import get_runtime_context
@@ -87,6 +88,7 @@ __all__ = [
     "available_resources",
     "cluster_resources",
     "nodes",
+    "JobConfig",
     "ObjectRef",
     "ObjectRefGenerator",
     "timeline",

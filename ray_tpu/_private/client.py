@@ -36,6 +36,17 @@ from .serialization import (
 )
 
 
+# Per-CALL job identity override for worker processes: an actor with
+# max_concurrency > 1 serves callers from different tenants at once, so
+# identity must live in the execution context (one per pool thread /
+# asyncio task), never in shared CoreClient fields — or caller A's
+# nested submits get stamped with caller B's tenant and quota.
+# worker_process._adopt_job_identity sets it; _stamp_job reads it first.
+from contextvars import ContextVar
+
+_job_identity: ContextVar = ContextVar("ray_tpu_job_identity", default=None)
+
+
 def connect_hub(addr: str):
     """Dial the hub: "tcp://host:port" (cluster mode) or an AF_UNIX path."""
     if addr.startswith("tcp://"):
@@ -77,6 +88,12 @@ class CoreClient:
         # connection, large ones chunk-stream into the head-node store
         # (encode_value / _fetch_segment_chunked)
         self.inline_only = False
+        # multi-tenant scheduling identity (set by register_job): every
+        # submit/PG-create from this client is stamped with it so the
+        # hub's fairsched engine can order/quota/preempt per tenant
+        self.job_id: Optional[str] = None
+        self.tenant: Optional[str] = None
+        self.priority: int = 0
         # pubsub: channel -> callback(data); callbacks run on the reader
         # thread, so keep them light (print/enqueue)
         self.subscriptions: Dict[str, Any] = {}
@@ -279,6 +296,7 @@ class CoreClient:
         P.GET, P.WAIT, P.KV_GET, P.KV_PUT, P.KV_KEYS, P.KV_DEL,
         P.GET_ACTOR, P.GET_FUNCTION, P.LIST_STATE, P.CLUSTER_RESOURCES,
         P.PG_READY, P.STREAM_NEXT, P.STREAM_CREDIT, P.FETCH_OBJECT,
+        P.REGISTER_JOB,  # idempotent upsert keyed by job_id
     }
     _RETRY_PERIOD_S = 2.0
 
@@ -540,10 +558,58 @@ class CoreClient:
         unlocked append."""
         self._release_buf.append(oid)  # graftlint: disable=GL001
 
+    # ------------------------------------------------------------------ jobs
+    def register_job(
+        self,
+        job_id: str,
+        tenant: str = "default",
+        priority: int = 0,
+        quota: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """Register this client's scheduling identity with the hub's
+        multi-tenant policy engine (fairsched): tenant id, priority,
+        optional resource quota. Later submits are stamped with it."""
+        self.job_id = job_id
+        self.tenant = tenant or "default"
+        self.priority = int(priority or 0)
+        self.request(P.REGISTER_JOB, {
+            "job_id": job_id, "tenant": self.tenant,
+            "priority": self.priority,
+            # tri-state: None = keep the tenant's existing cap;
+            # {} = explicitly lift it; a dict = replace it
+            "quota": None if quota is None else dict(quota),
+        })
+
+    def _stamp_job(self, options: dict) -> None:
+        """Attach the job identity to a submit's options (per-call
+        priority=/tenant= overrides win via setdefault). The execution
+        context's identity (set per task/actor call in workers) takes
+        precedence over the client-wide registered one."""
+        ident = _job_identity.get()
+        if ident is None:
+            ident = (self.job_id, self.tenant, self.priority)
+        job_id, tenant, priority = ident
+        explicit_tenant = options.get("tenant")
+        if explicit_tenant and explicit_tenant != tenant:
+            # per-call tenant OVERRIDE: this is deliberately not the
+            # registered job's work — attaching its job_id/priority
+            # would account another tenant's traffic to this job
+            return
+        # each field stamps independently: a per-call priority= without
+        # any registered job (job_id None) must still follow nested
+        # submits, or fanned-out work escapes quota/priority
+        if job_id is not None:
+            options.setdefault("job_id", job_id)
+        if tenant:
+            options.setdefault("tenant", tenant)
+        if priority:
+            options.setdefault("priority", priority)
+
     # ----------------------------------------------------------------- tasks
     def register_function(self, fn_id: str, blob: bytes) -> None:
         if fn_id not in self._seen_fns:
-            self._seen_fns[fn_id] = True
+            # per-process memo of exported fn digests (content-bounded)
+            self._seen_fns[fn_id] = True  # graftlint: disable=GL009
             self.send_async(P.REGISTER_FUNCTION, {"fn_id": fn_id, "blob": blob})
 
     def submit_task(
@@ -559,6 +625,7 @@ class CoreClient:
     ):
         task_id = TaskID.generate()
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
+        self._stamp_job(options)
         self.send_async(
             P.SUBMIT_TASK,
             {
@@ -587,6 +654,7 @@ class CoreClient:
     ) -> Tuple[ActorID, ObjectID]:
         actor_id = ActorID.generate()
         ready_id = ObjectID.generate()
+        self._stamp_job(options)
         payload = {
             "actor_id": actor_id.binary(),
             "fn_id": fn_id,
@@ -620,6 +688,10 @@ class CoreClient:
     ):
         task_id = TaskID.generate()
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
+        # actor calls carry no resources (no quota charge), but the
+        # identity must ride along so submits NESTED inside the method
+        # inherit it (worker_process._adopt_job_identity)
+        self._stamp_job(options)
         self.send_async(
             P.SUBMIT_ACTOR_TASK,
             {
@@ -660,8 +732,23 @@ class CoreClient:
         reply = self.request(P.GET_ACTOR, {"name": name, "namespace": namespace})
         return reply.get("actor_id")
 
-    def create_placement_group(self, bundles, strategy: str, name: str = "") -> bytes:
-        reply = self.request(P.CREATE_PG, {"bundles": bundles, "strategy": strategy, "name": name})
+    def create_placement_group(
+        self,
+        bundles,
+        strategy: str,
+        name: str = "",
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+    ) -> bytes:
+        payload = {"bundles": bundles, "strategy": strategy, "name": name}
+        # explicit overrides land BEFORE stamping: _stamp_job must see
+        # a tenant override to know not to attach this job's identity
+        if tenant is not None:
+            payload["tenant"] = tenant
+        if priority is not None:
+            payload["priority"] = int(priority)
+        self._stamp_job(payload)
+        reply = self.request(P.CREATE_PG, payload)
         if reply.get("error"):
             raise ValueError(reply["error"])
         return reply["pg_id"]

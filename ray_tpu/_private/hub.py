@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from . import protocol as P
 from .debug import log_exc, proc_rss_bytes
+from .fairsched import FairScheduler, QuotaInfeasibleError
 from .ids import WorkerID
 from .serialization import (
     dumps_frame,
@@ -153,6 +154,10 @@ class WorkerEntry:
     node_id: str = "node0"
     runtime_env_hash: str = ""  # workers only serve matching runtime envs
     spawned_for_actor: bool = False  # purpose of the spawn (quota math)
+    # gang preemption in progress: this worker is being killed to free
+    # its gang's reservation; its task requeues / its actor restarts
+    # WITHOUT burning the retry/restart budget
+    preempted: bool = False
     state: str = "starting"  # starting | idle | busy | actor | dead
     current_task: Optional[TaskSpec] = None
     actor_id: Optional[bytes] = None
@@ -193,6 +198,27 @@ class PGEntry:
     strategy: str
     name: str = ""
     ready: bool = True
+    # multi-tenant scheduling identity (fairsched): the creating job's
+    # tenant/priority decide who may preempt whom
+    tenant: str = "default"
+    priority: int = 0
+    job_id: str = ""
+    seq: int = 0  # creation order (newest-first victim selection)
+    # set on a preempted PG: stand aside from re-reserving until the
+    # beneficiary reservation (pg_id) is ready or gone, so the victim
+    # cannot re-grab the chips it was just preempted off of. The
+    # monotonic deadline bounds the stand-aside: a beneficiary that
+    # never seats (mis-estimated feasibility) must not starve its
+    # victims forever.
+    yield_to: Optional[bytes] = None
+    yield_until: float = 0.0
+    # last time THIS entry ATTEMPTED preemption (monotonic): the 50ms
+    # pg_ready poll must not turn a stuck reservation into a kill storm
+    last_preempt_t: float = 0.0
+    # rounds of victims this entry has shed without seating: capped so
+    # a misestimated reservation cannot kill/restart the same gangs
+    # every backoff window forever
+    preempt_rounds: int = 0
     # per-bundle available resources (bundle reservations are exclusive)
     bundle_avail: List[Dict[str, float]] = field(default_factory=list)
     # node each bundle was reserved on (set when ready)
@@ -237,6 +263,15 @@ class WaitReq:
     # incremental ready counter: arrivals bump this instead of re-scanning
     # all ids (a 1k-ref wait used to cost O(n) per arrival = O(n^2) total)
     n_ready: int = 0
+
+
+def _sum_bundle_resources(bundles: List[Dict[str, float]]) -> Dict[str, float]:
+    """Fold a PG's bundles into one total-resource dict."""
+    total: Dict[str, float] = {}
+    for b in bundles:
+        for k, v in b.items():
+            total[k] = total.get(k, 0.0) + v
+    return total
 
 
 def _find_chip_path(coords: Dict[int, tuple], free: Set[int],
@@ -364,7 +399,17 @@ class Hub:
         self.conn_to_worker: Dict[Any, str] = {}
         self.actors: Dict[bytes, ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}
+        # permanently-dead actor ids, FIFO: beyond the cap the oldest
+        # tombstones leave the actor tables (GL009: handler-grown
+        # registries need a pruning edge; the reference likewise caps
+        # its dead-actor cache, gcs_actor_manager maxDestroyedActors)
+        self._dead_actors: deque = deque()
         self.pgs: Dict[bytes, PGEntry] = {}
+        # multi-tenant scheduling policy: priority + fair-share
+        # ordering, quota admission, gang preemption (fairsched.py).
+        # Inert (O(1) no-ops) until the first job/tenant registers.
+        self.fairsched = FairScheduler()
+        self._tenant_gauges: Dict[str, dict] = {}
         # durable KV backend (reference: GCS StorageType in-memory vs
         # redis — gcs_server.h; here an append-log + snapshot on the
         # head's disk, _private/store.py). None = in-memory only.
@@ -750,6 +795,13 @@ class Hub:
         self._bm_events_total = bm(
             "ray_tpu_events_total", "counter",
             "flight-recorder events recorded")
+        self._bm_preemptions = bm(
+            "ray_tpu_sched_preemptions_total", "counter",
+            "gangs (placement groups / tasks) preempted for "
+            "higher-priority reservations")
+        self._bm_pending_quota = bm(
+            "ray_tpu_sched_pending_quota", "gauge",
+            "tasks parked at admission by their tenant's quota")
 
     def _bm_store_gauge(self, node: NodeEntry) -> None:
         g = self._node_gauges.get(node.node_id)
@@ -936,7 +988,8 @@ class Hub:
             agent_conn=conn,
             store_cap=float(p.get("store_cap") or 0),
         )
-        self.nodes[node.node_id] = node
+        # dead nodes stay as tombstones for introspection/lineage
+        self.nodes[node.node_id] = node  # graftlint: disable=GL009
         self.agent_conns[conn] = node.node_id
         self._record_event(
             "node_up", node_id=node.node_id, hostname=node.hostname,
@@ -1578,7 +1631,9 @@ class Hub:
         key = (p["name"], p["tags"])
         m = self.metrics.get(key)
         if m is None:
-            m = self.metrics[key] = {
+            # cardinality is bounded by distinct (name, tags) series —
+            # a scrape registry, not a per-request table
+            m = self.metrics[key] = {  # graftlint: disable=GL009
                 "name": p["name"],
                 "type": p["type"],
                 "description": p.get("description", ""),
@@ -1636,7 +1691,8 @@ class Hub:
     # ----- pubsub (reference: src/ray/pubsub/publisher.h:300 — here a
     # direct push over the subscriber's persistent connection)
     def _on_subscribe(self, conn, p):
-        subs = self.subscribers.setdefault(p["channel"], [])
+        # channel-name cardinality bounded; conns pruned on disconnect
+        subs = self.subscribers.setdefault(p["channel"], [])  # graftlint: disable=GL009
         if conn not in subs:
             subs.append(conn)
 
@@ -1665,9 +1721,41 @@ class Hub:
         wid = self.conn_to_worker.get(conn, "?")
         self._publish("__logs__", dict(p, worker_id=wid))
 
+    # ----- jobs (multi-tenant scheduling registry)
+    def _on_register_job(self, conn, p):
+        """Register a driver/job's scheduling identity: tenant id,
+        priority, optional quota (fairsched). Called from
+        init(job_config=...) and by submitted jobs; pruned when the
+        registering connection goes away (_handle_disconnect)."""
+        entry = self.fairsched.register_job(
+            p.get("job_id") or f"job-{id(conn):x}",
+            tenant=p.get("tenant") or "default",
+            priority=self.fairsched.priority_of(p),
+            quota=p.get("quota"),  # tri-state: None keeps the old cap
+            conn_id=id(conn),
+        )
+        self._record_event(
+            "job_registered", job_id=entry.job_id, tenant=entry.tenant,
+            priority=entry.priority, quota=dict(entry.quota),
+        )
+        # a lowered quota can strand parked work that now exceeds the
+        # cap outright — fail it loudly rather than wedge the queue
+        cap = self.fairsched.tenants.get(entry.tenant)
+        for spec in self.fairsched.pop_infeasible(entry.tenant):
+            self._fail_task(spec, ValueError(
+                f"task requires {spec.resources} but tenant "
+                f"'{entry.tenant}' quota is now "
+                f"{cap.quota if cap else {}} — it can never be admitted"
+            ))
+        self._refresh_pending_quota_gauge()
+        self._reply(conn, p["req_id"], ok=True)
+        self._dispatch()  # a quota change can unblock parked work
+
     # ----- functions
     def _on_register_function(self, conn, p):
-        self.functions[p["fn_id"]] = p["blob"]
+        # content-addressed export table: retries and late-spawning
+        # workers may fetch any registered fn for the session's life
+        self.functions[p["fn_id"]] = p["blob"]  # graftlint: disable=GL009
 
     def _on_get_function(self, conn, p):
         self._reply(conn, p["req_id"], blob=self.functions.get(p["fn_id"]))
@@ -1737,10 +1825,38 @@ class Hub:
     def _sched_class(self, spec: TaskSpec) -> tuple:
         pg = spec.options.get("placement_group")
         res_key = tuple(sorted(spec.resources.items()))
+        # tenant and priority terminate the tuple — fairsched's class
+        # ordering reads them positionally (class_order_key)
         return (res_key, pg[0] if pg else None, pg[1] if pg else None,
-                spec.options.get("runtime_env_hash", ""))
+                spec.options.get("runtime_env_hash", ""),
+                spec.options.get("tenant") or "default",
+                self.fairsched.priority_of(spec.options))
 
     def _enqueue_runnable(self, spec: TaskSpec):
+        try:
+            admitted = self.fairsched.admit(spec)
+        except QuotaInfeasibleError as err:
+            # the request exceeds the quota outright: it could never be
+            # admitted — fail loudly instead of parking forever (and
+            # wedging the tenant's FIFO queue behind it)
+            self.tasks[spec.task_id] = spec
+            self._fail_task(spec, ValueError(str(err)))
+            return
+        if not admitted:
+            # over-quota: parked in the tenant's pending_quota queue;
+            # re-admitted by _dispatch_once as finishing work frees room
+            self.tasks[spec.task_id] = spec
+            self._task_event(spec.task_id, state="PENDING_QUOTA")
+            self._refresh_pending_quota_gauge()
+            return
+        self._enqueue_ready(spec)
+
+    def _refresh_pending_quota_gauge(self) -> None:
+        self._bm_pending_quota["value"] = float(
+            self.fairsched.parked_count()
+        )
+
+    def _enqueue_ready(self, spec: TaskSpec, dispatch: bool = True):
         key = self._sched_class(spec)
         q = self.runnable.get(key)
         if q is None:
@@ -1751,7 +1867,8 @@ class Hub:
         ev = self._task_event_index.get(spec.task_id)
         if ev is not None:
             ev["t_queued"] = time.monotonic()
-        self._dispatch()
+        if dispatch:
+            self._dispatch()
 
     def _resources_fit(self, need: Dict[str, float], avail: Dict[str, float]) -> bool:
         return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
@@ -1825,7 +1942,26 @@ class Hub:
         # Head-only placement per scheduling class: O(#classes) per event.
         self._spawn_wants = {}
         empty_keys = []
-        for key, q in list(self.runnable.items()):
+        # re-admit quota-parked work that now fits (finishing tasks
+        # freed admitted usage since the last pass)
+        unparked = self.fairsched.pop_admissible()
+        if unparked:
+            for spec in unparked:
+                self._task_event(spec.task_id, state="PENDING_SCHEDULING")
+                self._enqueue_ready(spec, dispatch=False)
+            self._refresh_pending_quota_gauge()
+        classes = list(self.runnable.items())
+        if len(classes) > 1:
+            # policy order: priority first, then the tenant furthest
+            # below its weighted fair share. The sort is stable, so
+            # same-priority/same-tenant classes keep insertion order —
+            # and a blocked class never stops the walk: every class
+            # still gets its head-of-queue placement attempt per pass
+            # (no head-of-line blocking across classes).
+            classes.sort(
+                key=lambda kv: self.fairsched.class_order_key(kv[0])
+            )
+        for key, q in classes:
             while q:
                 self._last_spawn_node = None
                 placed = self._try_place(q[0])
@@ -2061,6 +2197,9 @@ class Hub:
             worker_id=worker.worker_id, node_id=worker.node_id,
         )
         self._bm_placed["value"] += 1
+        if self.fairsched.tenants:
+            self.fairsched.charge_dispatch(spec)
+            self._update_tenant_gauges()
         # measure from the LATEST queue entry (retries re-stamp
         # t_queued), falling back to submit — a retry of a 10s task
         # must not record a 10s "placement"
@@ -2087,9 +2226,14 @@ class Hub:
                 "ready_id": spec.ready_id,
                 "options": {
                     k: v for k, v in spec.options.items()
+                    # tenant/priority/job_id ride along so NESTED
+                    # submits from inside the task inherit the job's
+                    # scheduling identity (quota/fairness/priority
+                    # must not be escapable by fanning out subtasks)
                     if k in ("max_concurrency", "streaming",
                              "_generator_backpressure_num_objects",
-                             "_restarted", "placement_group")
+                             "_restarted", "placement_group",
+                             "tenant", "priority", "job_id")
                 },
             },
         )
@@ -2236,6 +2380,10 @@ class Hub:
         if self._maybe_retry_app_error(spec, p["returns"]):
             self._dispatch()
             return
+        if spec is not None:
+            # final completion: the quota admission charge comes back
+            # (retries above keep it — the task is still in the system)
+            self.fairsched.release_admission(spec.task_id)
         if spec is not None and not spec.is_actor_create:
             # actor-creation pins persist for the actor's lifetime
             # (restart replays the creation args); everything else
@@ -2319,7 +2467,38 @@ class Hub:
         self._enqueue_runnable(spec)
         return True
 
+    def _update_tenant_gauges(self) -> None:
+        """Per-tenant share-of-running-work gauges (fairsched)."""
+        tenants = self.fairsched.tenants
+        total = sum(t.rate for t in tenants.values())
+        for name, t in tenants.items():
+            g = self._tenant_gauges.get(name)
+            if g is None:
+                g = self._tenant_gauges[name] = self._bm(
+                    "ray_tpu_tenant_running_share", "gauge",
+                    "tenant's share of currently running work "
+                    "(chips, else CPUs)", (("tenant", name),))
+            g["value"] = (t.rate / total) if total > 0 else 0.0
+        for name in [n for n in self._tenant_gauges if n not in tenants]:
+            # dropped tenant: delete the series (zeroing it would leak
+            # one gauge per tenant name ever seen under client churn —
+            # the registry-growth class GL009 polices)
+            self._tenant_gauges.pop(name)
+            self.metrics.pop(
+                ("ray_tpu_tenant_running_share", (("tenant", name),)), None
+            )
+
     def _release_task_resources(self, spec: TaskSpec):
+        # the dispatch interval ends whenever the resources release
+        # (done, failed, retried, preempted) — fold the fair-share
+        # clock; the quota charge is released separately at FINAL
+        # completion (release_admission). Settle is UNGATED: even with
+        # every tenant pruned (driver churn), the task's _running entry
+        # must pop or the engine leaks one per in-flight task (GL009).
+        self.fairsched.settle(spec.task_id)
+        # unconditionally: settle/release may have pruned the LAST
+        # tenant, and the gauge sweep is what deletes its stale series
+        self._update_tenant_gauges()
         pool = spec.options.pop("_pool", None)
         if pool is None:
             return
@@ -2351,7 +2530,17 @@ class Hub:
             name=spec.fn_id or (spec.method or ""), error=str(err)[:200],
         )
         self.tasks.pop(spec.task_id, None)
+        self.fairsched.settle(spec.task_id)
+        self.fairsched.release_admission(spec.task_id)
         self._unpin_deps(spec)
+        if spec.is_actor_create and spec.actor_id is not None:
+            # a failed CREATION must kill the actor entry too, or
+            # queued method calls park in pending_calls forever with
+            # the actor wedged in state "pending"
+            actor = self.actors.get(spec.actor_id)
+            if actor is not None and actor.state != "dead":
+                actor.state = "dead"
+                self._drain_actor_queue_with_error(actor)
 
     # ----- actors
     def _on_create_actor(self, conn, p):
@@ -2511,7 +2700,9 @@ class Hub:
                 "return_ids": spec.return_ids,
                 "options": {
                     k: v for k, v in spec.options.items()
-                    if k in ("streaming", "_generator_backpressure_num_objects")
+                    if k in ("streaming",
+                             "_generator_backpressure_num_objects",
+                             "tenant", "priority", "job_id")
                 },
             },
         )
@@ -2535,9 +2726,23 @@ class Hub:
             self._unpin_deps(spec)
         actor.inflight.clear()
         # the actor is permanently dead here on every call path: drop
-        # the creation-arg pins
+        # the creation-arg pins, release its quota admission, and push
+        # a tombstone — beyond the cap the oldest dead actors leave the
+        # registry (handler-grown tables must prune: graftlint GL009)
         self._unpin_ids(actor.creation_pins)
         actor.creation_pins = []
+        self.fairsched.settle(actor.actor_id)
+        self.fairsched.release_admission(actor.actor_id)
+        self._dead_actors.append(actor.actor_id)
+        while len(self._dead_actors) > 10000:
+            old_id = self._dead_actors.popleft()
+            old = self.actors.get(old_id)
+            if old is None or old.state != "dead":
+                continue  # reused id or resurrected entry: keep it
+            self.actors.pop(old_id, None)
+            key = (old.options.get("namespace") or "default", old.name)
+            if old.name and self.named_actors.get(key) == old_id:
+                self.named_actors.pop(key, None)
 
     def _on_kill_actor(self, conn, p):
         actor = self.actors.get(p["actor_id"])
@@ -2566,6 +2771,9 @@ class Hub:
                 q = self.runnable.get(key)
                 if q is not None and spec in q:
                     q.remove(spec)
+                # the creation may be quota-parked instead of runnable
+                if self.fairsched.unpark(spec):
+                    self._refresh_pending_quota_gauge()
                 self._unpin_deps(spec)
             actor.state = "dead"
             blob = dumps_inline(ActorDiedError(msg="The actor was killed before it started."))
@@ -2636,6 +2844,11 @@ class Hub:
         cid = id(conn)
         for key in [k for k in self._inflight_reqs if k[0] == cid]:
             del self._inflight_reqs[key]
+        self.fairsched.drop_conn(cid)
+        # prune per-tenant gauges for tenants the drop removed (the
+        # charge/settle sites are gated on live tenants and would
+        # otherwise leave a stale last-value series forever)
+        self._update_tenant_gauges()
         node_id = self.agent_conns.pop(conn, None)
         if node_id is not None:
             self._node_died(node_id)
@@ -2723,6 +2936,17 @@ class Hub:
                 self._fail_task(spec, OutOfMemoryError(
                     "worker exceeded the per-worker memory threshold "
                     f"({self.config.memory_usage_threshold:.0f} bytes)"))
+            elif spec.options.pop("_preempted", False):
+                # gang preemption: requeue with lineage intact WITHOUT
+                # burning the crash-retry budget (the task did nothing
+                # wrong; the scheduler took its chips back)
+                self._bm_task_retry["value"] += 1
+                self._record_event(
+                    "task_retry", task_id=spec.task_id.hex(),
+                    reason="preempted", retries_left=spec.retries_left,
+                )
+                self._task_event(spec.task_id, state="PENDING_RETRY")
+                self._enqueue_runnable(spec)
             elif spec.retries_left > 0:
                 spec.retries_left -= 1
                 self._bm_task_retry["value"] += 1
@@ -2757,8 +2981,12 @@ class Hub:
                         if home is not None:
                             self._release(actor.resources, home.avail)
                     actor.pool = None
-                if actor.restarts_left != 0:
-                    if actor.restarts_left > 0:
+                    self.fairsched.settle(actor.actor_id)
+                if actor.restarts_left != 0 or worker.preempted:
+                    # preemption restarts through this same path but
+                    # never burns the restart budget (existing
+                    # actor_restart machinery, reference semantics)
+                    if actor.restarts_left > 0 and not worker.preempted:
                         actor.restarts_left -= 1
                     actor.state = "restarting"
                     actor.worker_id = None
@@ -2818,6 +3046,14 @@ class Hub:
                     self.tasks.pop(spec.task_id, None)
                     self._fail_task(spec, TaskCancelledError("task was cancelled"))
                     return
+        # quota-parked tasks (fairsched pending_quota)
+        for spec in self.fairsched.parked_specs():
+            if oid in spec.return_ids:
+                self.fairsched.unpark(spec)
+                self._refresh_pending_quota_gauge()
+                self.tasks.pop(spec.task_id, None)
+                self._fail_task(spec, TaskCancelledError("task was cancelled"))
+                return
         # queued actor calls
         for actor in self.actors.values():
             for spec in list(actor.pending_calls):
@@ -2898,6 +3134,17 @@ class Hub:
             )
             return
         pg_id = PlacementGroupID.generate().binary()
+        # PG reservations hold resources exclusively — they count
+        # against the tenant's quota like admitted tasks (and tasks
+        # placed INTO the PG are exempt, so nothing double-counts).
+        # Over-quota reservations fail fast instead of queueing.
+        quota_err = self.fairsched.charge_reservation(
+            pg_id, p.get("tenant") or "default",
+            _sum_bundle_resources(bundles),
+        )
+        if quota_err is not None:
+            self._reply(conn, p["req_id"], error=quota_err, pg_id=None)
+            return
         entry = PGEntry(
             pg_id=pg_id,
             bundles=bundles,
@@ -2905,12 +3152,61 @@ class Hub:
             name=p.get("name", ""),
             ready=False,
             bundle_avail=[dict(b) for b in bundles],
+            tenant=p.get("tenant") or "default",
+            priority=self.fairsched.priority_of(p),
+            job_id=p.get("job_id") or "",
+            seq=next(self._pg_counter),
         )
         self.pgs[pg_id] = entry
         self._try_reserve_pg(entry)
         self._reply(conn, p["req_id"], pg_id=pg_id)
 
     def _try_reserve_pg(self, entry: PGEntry):
+        """Reserve a PG's bundles, preempting lower-priority gangs when
+        the reservation cannot fit (fairsched). A freshly-preempted PG
+        stands aside (yield_to) until its beneficiary's reservation
+        lands, so victims can't re-grab the chips they were taken off."""
+        if entry.ready:
+            return
+        if entry.yield_to is not None:
+            ben = self.pgs.get(entry.yield_to)
+            if (
+                ben is not None
+                and not ben.ready
+                and time.monotonic() < entry.yield_until
+            ):
+                return
+            # beneficiary seated, vanished, or overstayed its window
+            # (it may never become schedulable): stop standing aside
+            entry.yield_to = None
+        self._reserve_pg_attempt(entry)
+        if entry.ready:
+            return
+        # Preemption sweep under the dispatch guard: _worker_died runs
+        # _dispatch at the end of every victim kill, and on the
+        # _on_create_pg/_on_pg_ready entry paths (outside a _dispatch
+        # frame) that dispatch would re-place freed chips — or requeue
+        # gang tasks into the still-ready victim PG — before the
+        # beneficiary's re-reservation gets its turn, defeating the
+        # preemption. Holding the flag defers those dispatches to one
+        # pass AFTER the reservation retry.
+        was_dispatching = self._dispatching
+        self._dispatching = True
+        try:
+            preempted = self._preempt_for_pg(entry)
+            if preempted:
+                # victims died synchronously on this thread: their
+                # chips and resources are back — retry right now
+                entry.preempt_rounds += 1
+                self._reserve_pg_attempt(entry)
+        finally:
+            self._dispatching = was_dispatching
+        if entry.ready:
+            entry.preempt_rounds = 0
+        if preempted and not was_dispatching:
+            self._dispatch()  # run the kills' deferred dispatch work
+
+    def _reserve_pg_attempt(self, entry: PGEntry):
         """Assign each bundle to a node and acquire its resources — the
         reference's 2-phase GcsPlacementGroupScheduler collapsed to one
         atomic pass over the hub's authoritative node table
@@ -2927,10 +3223,7 @@ class Hub:
         snap = {n.node_id: dict(n.avail) for n in nodes}
         assign: List[str] = []
         if entry.strategy in ("PACK", "STRICT_PACK"):
-            total: Dict[str, float] = {}
-            for b in entry.bundles:
-                for k, v in b.items():
-                    total[k] = total.get(k, 0.0) + v
+            total = _sum_bundle_resources(entry.bundles)
             for n in nodes:
                 if self._resources_fit(total, snap[n.node_id]):
                     assign = [n.node_id] * len(entry.bundles)
@@ -2976,10 +3269,7 @@ class Hub:
         total = sum(need)
         topo_nodes = [n for n in nodes if n.chip_coords]
         # 1) whole gang on one host, one contiguous path
-        total_res: Dict[str, float] = {}
-        for b in entry.bundles:
-            for k, v in b.items():
-                total_res[k] = total_res.get(k, 0.0) + v
+        total_res = _sum_bundle_resources(entry.bundles)
         for n in topo_nodes:
             if not self._resources_fit(total_res, n.avail):
                 continue
@@ -3073,36 +3363,185 @@ class Hub:
 
     def _on_remove_pg(self, conn, p):
         entry = self.pgs.pop(p["pg_id"], None)
-        if entry is not None and entry.ready:
-            for b, nid in zip(entry.bundles, entry.bundle_nodes):
-                node = self.nodes.get(nid)
-                if node is not None and node.alive:
-                    self._release(b, node.avail)
-            if entry.bundle_chips:
-                for nid, chunk in zip(entry.bundle_nodes, entry.bundle_chips):
-                    node = self.nodes.get(nid)
-                    if node is None:
-                        continue
-                    node.pg_reserved_chips.difference_update(chunk)
-                    # chips pinned by IDLE pooled workers come back
-                    # immediately (kill the worker — its jax binding is
-                    # useless outside the removed PG); busy/actor
-                    # workers release theirs on death (see _worker_died)
-                    pinned = set()
-                    for w in list(self.workers.values()):
-                        if w.node_id != nid or not w.pinned_chips:
-                            continue
-                        if (
-                            w.state == "idle"
-                            and w.actor_id is None
-                            and set(w.pinned_chips) & set(chunk)
-                        ):
-                            self._kill_worker(w)
-                            self._worker_died(w)
-                            continue
-                        pinned.update(w.pinned_chips)
-                    node.free_tpu_chips.update(set(chunk) - pinned)
+        if entry is not None:
+            self._release_pg_reservation(entry)
+            self.fairsched.release_admission(entry.pg_id)
         self._dispatch()
+
+    def _release_pg_reservation(self, entry: PGEntry):
+        """Return a ready PG's bundles (and SLICE chips) to their nodes
+        and reset the entry to the unreserved state. Used by PG removal
+        and by gang preemption (where the entry stays registered so the
+        victim can re-reserve later)."""
+        if not entry.ready:
+            return
+        for b, nid in zip(entry.bundles, entry.bundle_nodes):
+            node = self.nodes.get(nid)
+            if node is not None and node.alive:
+                self._release(b, node.avail)
+        if entry.bundle_chips:
+            for nid, chunk in zip(entry.bundle_nodes, entry.bundle_chips):
+                node = self.nodes.get(nid)
+                if node is None:
+                    continue
+                node.pg_reserved_chips.difference_update(chunk)
+                # chips pinned by IDLE pooled workers come back
+                # immediately (kill the worker — its jax binding is
+                # useless outside the removed PG); busy/actor
+                # workers release theirs on death (see _worker_died)
+                pinned = set()
+                for w in list(self.workers.values()):
+                    if w.node_id != nid or not w.pinned_chips:
+                        continue
+                    if (
+                        w.state == "idle"
+                        and w.actor_id is None
+                        and set(w.pinned_chips) & set(chunk)
+                    ):
+                        self._kill_worker(w)
+                        self._worker_died(w)
+                        continue
+                    pinned.update(w.pinned_chips)
+                node.free_tpu_chips.update(set(chunk) - pinned)
+        entry.ready = False
+        entry.bundle_avail = [dict(b) for b in entry.bundles]
+        entry.bundle_nodes = []
+        entry.bundle_chips = []
+
+    # ----- gang preemption (fairsched)
+    # one window bounds both sides of a preemption: a beneficiary may
+    # not preempt again, and its victims stand aside (yield_to), for
+    # this long — so a mis-estimated reservation can neither kill-storm
+    # nor starve its victims past the window
+    _PREEMPT_BACKOFF_S = 10.0
+    # and after this many victim rounds without seating, the
+    # beneficiary stops preempting entirely (preemption_gave_up event)
+    _PREEMPT_MAX_ROUNDS = 2
+
+    def _preempt_for_pg(self, entry: PGEntry) -> bool:
+        """A reservation cannot fit: reclaim capacity from strictly
+        lower-priority work — whole gangs (ready PGs) or single running
+        plain tasks, lowest priority first, never partial gangs. The
+        kills ride the existing retry/restart machinery, so preempted
+        tasks requeue with lineage intact and preempted actors restart
+        (actor_restart path). Returns True if anything was preempted."""
+        pri = int(entry.priority or 0)
+        now = time.monotonic()
+        if now - entry.last_preempt_t < self._PREEMPT_BACKOFF_S:
+            # this reservation already attempted preemption recently —
+            # the 50ms pg_ready poll must not turn a stuck reservation
+            # into a rolling kill storm (or a repeated O(workers+pgs)
+            # candidate sweep)
+            return False
+        if entry.preempt_rounds >= self._PREEMPT_MAX_ROUNDS:
+            # shed victims twice and still not seated: the feasibility
+            # estimate is wrong for this cluster shape — stop
+            # destroying lower-priority work (recorded once below)
+            if entry.preempt_rounds == self._PREEMPT_MAX_ROUNDS:
+                entry.preempt_rounds += 1
+                self._record_event(
+                    "preemption_gave_up", pg_id=entry.pg_id.hex(),
+                    tenant=entry.tenant, priority=entry.priority,
+                    rounds=self._PREEMPT_MAX_ROUNDS,
+                )
+            return False
+        # arm the backoff for EVERY attempt — including one that finds
+        # no candidates — so a reservation waiting on its 50ms poll
+        # pays this sweep at most once per window
+        entry.last_preempt_t = now
+        pg_cands = [
+            g for g in self.pgs.values()
+            if g.ready and g is not entry and int(g.priority or 0) < pri
+        ]
+        task_cands: List[Tuple[WorkerEntry, TaskSpec]] = []
+        for w in self.workers.values():
+            spec = w.current_task
+            if (
+                spec is None
+                or spec.is_actor_create
+                or spec.options.get("placement_group")
+            ):
+                continue  # PG-resident work dies with its gang, not alone
+            if self.fairsched.priority_of(spec.options) < pri:
+                task_cands.append((w, spec))
+        if not pg_cands and not task_cands:
+            return False
+        need_chips = sum(int(b.get("TPU", 0)) for b in entry.bundles)
+        max_bundle = max(
+            entry.bundles, key=lambda b: int(b.get("TPU", 0)),
+            default={},
+        )
+        need_res = _sum_bundle_resources(entry.bundles)
+        free_by_node: Dict[str, int] = {}
+        avail_by_node: Dict[str, Dict[str, float]] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            free_by_node[n.node_id] = len(n.free_tpu_chips)
+            avail_by_node[n.node_id] = dict(n.avail)
+        victim_pgs, victim_tasks = self.fairsched.preemption_victims(
+            pri, need_chips, max_bundle, need_res, pg_cands,
+            task_cands, free_by_node, avail_by_node,
+        )
+        for w, spec in victim_tasks:
+            self._bm_preemptions["value"] += 1
+            self.fairsched.note_preemption(spec.options)
+            self._record_event(
+                "preemption", gang="task", task_id=spec.task_id.hex(),
+                tenant=spec.options.get("tenant") or "default",
+                priority=self.fairsched.priority_of(spec.options),
+                by_pg=entry.pg_id.hex(), by_priority=pri,
+                by_tenant=entry.tenant,
+            )
+            spec.options["_preempted"] = True
+            w.preempted = True
+            self._kill_worker(w)
+            self._worker_died(w)
+        for pg in victim_pgs:
+            self._preempt_pg(pg, entry)
+        return bool(victim_pgs or victim_tasks)
+
+    def _preempt_pg(self, victim: PGEntry, beneficiary: PGEntry):
+        """Preempt one whole gang: kill every worker running a task or
+        hosting an actor placed in the victim PG (their specs requeue /
+        actors restart without burning budgets), then release the
+        reservation. The victim stands aside (yield_to) until the
+        beneficiary's reservation is ready, then re-reserves and its
+        requeued gang resumes."""
+        self._bm_preemptions["value"] += 1
+        self.fairsched.note_preemption(
+            {"tenant": victim.tenant, "job_id": victim.job_id}
+        )
+        self._record_event(
+            "preemption", gang="pg", pg_id=victim.pg_id.hex(),
+            name=victim.name, tenant=victim.tenant,
+            priority=victim.priority, by_pg=beneficiary.pg_id.hex(),
+            by_priority=beneficiary.priority, by_tenant=beneficiary.tenant,
+        )
+        victim.yield_to = beneficiary.pg_id
+        victim.yield_until = time.monotonic() + self._PREEMPT_BACKOFF_S
+        for w in list(self.workers.values()):
+            spec = w.current_task
+            in_gang = False
+            if spec is not None:
+                pgopt = spec.options.get("placement_group")
+                in_gang = bool(pgopt) and pgopt[0] == victim.pg_id
+            if not in_gang and w.actor_id:
+                actor = self.actors.get(w.actor_id)
+                in_gang = (
+                    actor is not None
+                    and actor.pool is not None
+                    and actor.pool[0] == "pg"
+                    and actor.pool[1] == victim.pg_id
+                )
+            if not in_gang:
+                continue
+            if spec is not None and not spec.is_actor_create:
+                spec.options["_preempted"] = True
+            w.preempted = True
+            self._kill_worker(w)
+            self._worker_died(w)
+        self._release_pg_reservation(victim)
 
     def _on_pg_ready(self, conn, p):
         entry = self.pgs.get(p["pg_id"])
@@ -3259,6 +3698,22 @@ class Hub:
                     shapes[key] = shapes.get(key, 0) + 1
             for key, count in shapes.items():
                 items.append({"shape": dict(key), "count": count})
+            # quota-parked work is visible but flagged: the autoscaler
+            # must NOT buy nodes for demand an admission quota blocks
+            # (post-quota demand, not raw queue depth)
+            pshapes: Dict[tuple, int] = {}
+            for spec in self.fairsched.parked_specs():
+                key = tuple(sorted(spec.resources.items()))
+                pshapes[key] = pshapes.get(key, 0) + 1
+            for key, count in pshapes.items():
+                items.append({
+                    "shape": dict(key), "count": count,
+                    "pending_quota": True,
+                })
+        elif kind == "jobs":
+            items = self.fairsched.job_table()
+        elif kind == "tenants":
+            items = self.fairsched.tenant_table()
         elif kind == "nodes":
             for n in self.nodes.values():
                 items.append(

@@ -41,6 +41,10 @@ GET_ACTOR = "get_actor"
 LIST_STATE = "list_state"
 CLUSTER_RESOURCES = "cluster_resources"
 SHUTDOWN = "shutdown"
+REGISTER_JOB = "register_job"  # driver/job -> hub: scheduling identity
+                               # {job_id, tenant, priority, quota} for
+                               # the fairsched policy engine (multi-
+                               # tenant priority/fair-share/preemption)
 
 # worker -> hub
 TASK_DONE = "task_done"

@@ -72,6 +72,7 @@ def init(
     max_workers: Optional[int] = None,
     worker_env: Optional[Dict[str, str]] = None,
     object_store_memory: Optional[float] = None,
+    job_config=None,
     **kwargs,
 ):
     """Start the runtime (hub thread + on-demand worker pool), or — with
@@ -100,6 +101,7 @@ def init(
                 worker_id=f"client_{os.getpid()}",
             )
             _client.inline_only = True  # no shared /dev/shm with the cluster
+            _register_job_config(_client, job_config)
             if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
                 _subscribe_worker_logs(_client)
             from . import usage
@@ -152,6 +154,7 @@ def init(
         )
         _hub.start()
         _client = CoreClient(_hub.addr, _session_dir, role="driver", worker_id="driver")
+        _register_job_config(_client, job_config)
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             _subscribe_worker_logs(_client)
         from . import usage
@@ -159,6 +162,28 @@ def init(
         usage.flush_pending()
         atexit.register(shutdown)
         return RuntimeContext()
+
+
+def _register_job_config(client: CoreClient, job_config) -> None:
+    """Register the driver's multi-tenant scheduling identity with the
+    hub (fairsched): explicit JobConfig wins; otherwise `job submit`'s
+    RAY_TPU_JOB_* env handoff applies; otherwise stay unregistered (the
+    policy engine stays inert for plain single-tenant sessions)."""
+    from ..job_config import JobConfig
+
+    if job_config is None:
+        job_config = JobConfig.from_env()
+    if job_config is None:
+        return
+    if not isinstance(job_config, JobConfig):
+        raise TypeError(
+            f"init(job_config=...) expects a ray_tpu.JobConfig, got "
+            f"{type(job_config)}"
+        )
+    client.register_job(
+        job_config.job_id, job_config.tenant, job_config.priority,
+        job_config.quota,
+    )
 
 
 def _subscribe_worker_logs(client: CoreClient) -> None:

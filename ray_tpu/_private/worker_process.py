@@ -163,8 +163,29 @@ class WorkerRuntime:
             )
         self.client.send(P.TASK_DONE, {"task_id": task_id, "returns": []})
 
+    def _adopt_job_identity(self, p: dict) -> None:
+        """Inherit the submitting job's scheduling identity (fairsched
+        tenant/priority/job_id, forwarded in the exec options) so
+        NESTED submits from inside this task are stamped with it —
+        quota admission and fair-share accounting must not be escapable
+        by fanning work out through a worker. Context-local, not client
+        fields: a max_concurrency actor serves different tenants
+        concurrently, and caller A's nested submits must never carry
+        caller B's identity."""
+        from .client import _job_identity
+
+        opts = p.get("options") or {}
+        try:
+            priority = int(opts.get("priority") or 0)
+        except (TypeError, ValueError):
+            priority = 0
+        _job_identity.set(
+            (opts.get("job_id"), opts.get("tenant"), priority)
+        )
+
     # ------------------------------------------------------------ execution
     def exec_task(self, p: dict):
+        self._adopt_job_identity(p)
         if p.get("tpu_chips"):
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in p["tpu_chips"])
         from ..runtime_context import _current_pg
@@ -216,6 +237,7 @@ class WorkerRuntime:
         self.client.send(P.TASK_DONE, {"task_id": p["task_id"], "returns": []})
 
     def exec_actor_create(self, p: dict):
+        self._adopt_job_identity(p)
         if p.get("tpu_chips"):
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in p["tpu_chips"])
         # the hub marks respawned incarnations so user __init__ can
@@ -246,12 +268,14 @@ class WorkerRuntime:
 
     def _run_actor_method(self, p: dict):
         # pool threads don't inherit the main loop's contextvars: pin
-        # the task id here so get_runtime_context() works under
-        # max_concurrency > 1
+        # the task id (and the caller's job identity, for nested
+        # submits) here so get_runtime_context() and fairsched stamping
+        # work under max_concurrency > 1
         from ..runtime_context import _current_pg, _current_task_id
 
         _current_task_id.set(p.get("task_id"))
         _current_pg.set(getattr(self, "actor_pg", None))
+        self._adopt_job_identity(p)
         method_name = p["method"]
         try:
             if method_name == "__ray_ready__":
@@ -294,6 +318,7 @@ class WorkerRuntime:
         return self.aio_loop
 
     def exec_actor_task(self, p: dict):
+        self._adopt_job_identity(p)
         import inspect
 
         method = getattr(type(self.actor_instance), p["method"], None) if p["method"] not in (

@@ -107,7 +107,14 @@ class Autoscaler:
     def step(self) -> None:
         """One reconcile pass (the reference's Autoscaler.update)."""
         client = self._client()
-        demand = client.list_state("demand")
+        # post-quota demand only: work parked by a tenant's admission
+        # quota (fairsched pending_quota) is reported flagged and MUST
+        # NOT drive scale-up — no amount of new nodes can dispatch it,
+        # and buying hardware a quota forbids using defeats the quota
+        demand = [
+            d for d in client.list_state("demand")
+            if not d.get("pending_quota")
+        ]
         avail_nodes = {
             n["node_id"]: n for n in client.list_state("nodes") if n["alive"]
         }
@@ -167,7 +174,11 @@ class Autoscaler:
             for w in client.list_state("workers")
             if w["state"] in ("busy", "actor")
         }
-        demand = client.list_state("demand")
+        # quota-parked demand must not hold idle nodes alive either
+        demand = [
+            d for d in client.list_state("demand")
+            if not d.get("pending_quota")
+        ]
         for node_id in list(self._owned_type):
             node = avail_nodes.get(node_id)
             nt = self._owned_type[node_id]

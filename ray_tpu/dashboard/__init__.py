@@ -10,8 +10,13 @@ chrome-trace timeline, and job submission/inspection:
     GET  /api/timeline           chrome://tracing JSON
     GET  /api/events             flight-recorder runtime events
     GET  /metrics                Prometheus text (user + ray_tpu_* builtin)
-    GET  /api/jobs               job table
-    POST /api/jobs               {"entrypoint": ...} -> {"job_id": ...}
+    GET  /api/jobs               scheduler view: {tenants (usage vs
+                                 quota), jobs (fairsched registry),
+                                 submissions (entrypoint job table)}
+    GET  /api/tenants            per-tenant usage vs quota only
+    POST /api/jobs               {"entrypoint": ..., "tenant": ...,
+                                 "priority": ..., "quota": ...}
+                                 -> {"job_id": ...}
     GET  /api/jobs/{id}          status
     GET  /api/jobs/{id}/logs     captured driver output
 """
@@ -64,7 +69,7 @@ class Dashboard:
             kind = request.match_info["kind"]
             allowed = {
                 "nodes", "actors", "tasks", "workers", "objects",
-                "placement_groups", "events",
+                "placement_groups", "events", "tenants",
             }
             if kind not in allowed:
                 raise web.HTTPNotFound(text=f"unknown kind {kind}")
@@ -99,7 +104,21 @@ class Dashboard:
             return JobSubmissionClient()
 
         async def jobs_list(request):
-            return web.json_response(_jobs_client().list_jobs())
+            # the scheduler view (fairsched: per-tenant usage vs quota,
+            # registered jobs) plus the entrypoint submission table.
+            # Submissions are best-effort: reading them instantiates
+            # the job-manager actor, which needs a live worker — the
+            # scheduler tables must render even when that fails.
+            client = self._client()
+            try:
+                submissions = _jobs_client().list_jobs()
+            except Exception:
+                submissions = []
+            return web.json_response({
+                "tenants": client.list_state("tenants"),
+                "jobs": client.list_state("jobs"),
+                "submissions": submissions,
+            })
 
         async def jobs_submit(request):
             body = await request.json()
@@ -108,6 +127,9 @@ class Dashboard:
                 submission_id=body.get("submission_id"),
                 runtime_env=body.get("runtime_env"),
                 metadata=body.get("metadata"),
+                tenant=body.get("tenant"),
+                priority=body.get("priority"),
+                quota=body.get("quota"),
             )
             return web.json_response({"job_id": job_id})
 

@@ -44,6 +44,9 @@ class _JobManager:
         submission_id: Optional[str] = None,
         runtime_env: Optional[dict] = None,
         metadata: Optional[dict] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+        quota: Optional[dict] = None,
     ) -> str:
         import os
         import subprocess
@@ -70,6 +73,20 @@ class _JobManager:
             env = dict(os.environ)
             # the job's driver connects to THIS cluster
             env["RAY_TPU_ADDRESS"] = os.environ.get("RAY_TPU_HUB_ADDR", "")
+            if tenant is not None or priority is not None or quota is not None:
+                # multi-tenant scheduling handoff: the entrypoint's
+                # init() reads RAY_TPU_JOB_* and registers with the
+                # hub's fairsched engine under this identity
+                from .job_config import JobConfig
+
+                env.update(
+                    JobConfig(
+                        tenant=tenant or "default",
+                        priority=priority or 0,
+                        quota=quota,
+                        job_id=job_id,
+                    ).env_vars()
+                )
             cwd = None
             renv = runtime_env or {}
             for k, v in (renv.get("env_vars") or {}).items():
@@ -167,10 +184,14 @@ class JobSubmissionClient:
         submission_id: Optional[str] = None,
         runtime_env: Optional[dict] = None,
         metadata: Optional[dict] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+        quota: Optional[dict] = None,
     ) -> str:
         return self._ray.get(
             self._mgr.submit.remote(
-                entrypoint, submission_id, runtime_env, metadata
+                entrypoint, submission_id, runtime_env, metadata,
+                tenant, priority, quota,
             )
         )
 

@@ -30,6 +30,8 @@ _TASK_OPTION_KEYS = {
     "runtime_env",
     "memory",
     "max_calls",
+    "priority",
+    "tenant",
     "_metadata",
 }
 
@@ -116,6 +118,13 @@ def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
             out["strategy"] = strategy
     if opts.get("max_retries") is not None:
         out["max_retries"] = opts["max_retries"]
+    # multi-tenant scheduling (fairsched): per-call priority/tenant
+    # override the driver's registered JobConfig (client._stamp_job
+    # fills the defaults with setdefault, so explicit values win)
+    if opts.get("priority") is not None:
+        out["priority"] = int(opts["priority"])
+    if opts.get("tenant"):
+        out["tenant"] = str(opts["tenant"])
     if opts.get("retry_exceptions"):
         # True = retry any application error; exception type(s) retry
         # only matching errors (reference: ray_option_utils semantics).

@@ -11,6 +11,7 @@ external CLI framework.
     python -m ray_tpu start --address tcp://ip:7777   # join as a node
     python -m ray_tpu status
     python -m ray_tpu list actors
+    python -m ray_tpu jobs                            # tenants vs quota
     python -m ray_tpu summary tasks
     python -m ray_tpu timeline --output /tmp/tl.json
     python -m ray_tpu memory
@@ -211,6 +212,9 @@ _LIST_COLUMNS = {
     "nodes": ["node_id", "alive", "hostname"],
     "objects": ["object_id", "size_bytes", "location"],
     "placement_groups": ["pg_id", "state", "strategy"],
+    "jobs": ["job_id", "tenant", "priority", "quota", "submitted",
+             "dispatched", "preempted"],
+    "tenants": ["tenant", "quota", "admitted", "share", "pending_quota"],
 }
 
 
@@ -265,6 +269,87 @@ def cmd_events(args) -> None:
     _print_table(rows, ["seq", "time", "kind", "detail"])
 
 
+def cmd_jobs(args) -> None:
+    """Multi-tenant scheduler view: per-tenant usage vs quota plus the
+    registered job table (fairsched). Quota units are hub resource
+    units — whole TPU chips, CPU cores, 'memory' bytes."""
+    from ray_tpu.util import state as state_api
+
+    _connect(args)
+    tenants = state_api.list_tenants()
+    jobs = state_api.list_jobs()
+    if args.format == "json":
+        print(json.dumps({"tenants": tenants, "jobs": jobs}, indent=2,
+                         default=str))
+        return
+
+    def _res(d):
+        return ",".join(f"{k}={v:g}" for k, v in sorted(d.items())) or "-"
+
+    print("tenants:")
+    _print_table(
+        [
+            {
+                "tenant": t["tenant"],
+                "quota": _res(t.get("quota", {})),
+                "in_use": _res(t.get("admitted", {})),
+                "share": f"{t.get('share', 0.0):.2f}",
+                "usage_s": f"{t.get('usage_s', 0.0):.1f}",
+                "pending_quota": t.get("pending_quota", 0),
+                "preempted": t.get("preempted", 0),
+            }
+            for t in tenants
+        ],
+        ["tenant", "quota", "in_use", "share", "usage_s",
+         "pending_quota", "preempted"],
+    )
+    print("\njobs:")
+    _print_table(
+        [
+            {
+                "job_id": j["job_id"],
+                "tenant": j["tenant"],
+                "priority": j["priority"],
+                "quota": _res(j.get("quota", {})),
+                "submitted": j.get("submitted", 0),
+                "dispatched": j.get("dispatched", 0),
+                "preempted": j.get("preempted", 0),
+            }
+            for j in jobs
+        ],
+        ["job_id", "tenant", "priority", "quota", "submitted",
+         "dispatched", "preempted"],
+    )
+
+
+def _parse_quota(spec: Optional[str]) -> dict:
+    """'TPU=4,CPU=8' -> {'TPU': 4.0, 'CPU': 8.0} (also accepts JSON)."""
+    if not spec:
+        return {}
+    spec = spec.strip()
+    bad = SystemExit(
+        f"--quota: expected RESOURCE=AMOUNT[,...] or a JSON object, "
+        f"got {spec!r}"
+    )
+    if spec.startswith("{"):
+        try:
+            return {str(k): float(v) for k, v in json.loads(spec).items()}
+        except (ValueError, TypeError, AttributeError):
+            raise bad from None
+    out = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        k, eq, v = part.partition("=")
+        if not eq or not k.strip():
+            raise bad
+        try:
+            out[k.strip()] = float(v)
+        except ValueError:
+            raise bad from None
+    return out
+
+
 def cmd_timeline(args) -> None:
     import ray_tpu
 
@@ -303,7 +388,15 @@ def cmd_job(args) -> None:
         entrypoint = shlex.join(args.entrypoint)
         if not entrypoint:
             raise SystemExit("job submit: pass the entrypoint after --")
-        job_id = client.submit_job(entrypoint=entrypoint)
+        job_id = client.submit_job(
+            entrypoint=entrypoint,
+            tenant=args.tenant,
+            priority=args.priority,
+            # tri-state: omitted --quota keeps the tenant's cap;
+            # an explicit empty spec ("{}") lifts it
+            quota=_parse_quota(args.quota) if args.quota is not None
+            else None,
+        )
         print(job_id)
         if args.wait:
             status = client.wait_until_finished(job_id, timeout=args.timeout)
@@ -371,7 +464,7 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "kind",
         choices=["actors", "tasks", "workers", "nodes", "objects",
-                 "placement_groups", "pgs"],
+                 "placement_groups", "pgs", "jobs", "tenants"],
     )
     sp.add_argument("--format", choices=["table", "json"], default="table")
     add_address(sp)
@@ -389,6 +482,14 @@ def _build_parser() -> argparse.ArgumentParser:
     add_address(sp)
     sp.set_defaults(fn=cmd_events)
 
+    sp = sub.add_parser(
+        "jobs", help="multi-tenant scheduler: tenants (usage vs quota) "
+                     "+ registered jobs"
+    )
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_jobs)
+
     sp = sub.add_parser("timeline", help="dump chrome://tracing timeline")
     sp.add_argument("--output", default=None)
     add_address(sp)
@@ -403,6 +504,12 @@ def _build_parser() -> argparse.ArgumentParser:
     j = jsub.add_parser("submit")
     j.add_argument("--wait", action="store_true")
     j.add_argument("--timeout", type=float, default=600.0)
+    j.add_argument("--tenant", default=None,
+                   help="fairsched tenant the job's work is accounted to")
+    j.add_argument("--priority", type=int, default=None,
+                   help="integer scheduling priority (higher wins)")
+    j.add_argument("--quota", default=None,
+                   help='resource quota, "TPU=4,CPU=8" or JSON')
     j.add_argument("entrypoint", nargs=argparse.REMAINDER)
     add_address(j)
     for name in ("status", "logs", "stop"):

@@ -11,4 +11,5 @@ from . import (  # noqa: F401
     gl006_accumulator_init,
     gl007_reflection_dispatch,
     gl008_wall_clock_duration,
+    gl009_unbounded_registry,
 )

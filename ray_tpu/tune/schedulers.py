@@ -143,6 +143,14 @@ class PopulationBasedTraining(TrialScheduler):
         self._latest[trial_id] = dict(metrics)
         return CONTINUE
 
+    def on_trial_complete(self, trial_id: str) -> None:
+        # drop the finished trial's state: it can no longer perturb and
+        # must leave the exploit pool — and a long tuning run must not
+        # accumulate one config/metrics dict per completed trial (GL009)
+        self._configs.pop(trial_id, None)
+        self._latest.pop(trial_id, None)
+        self._last_perturb.pop(trial_id, None)
+
     def exploit(self, trial_id: str) -> Optional[tuple]:
         m = self._latest.get(trial_id)
         if not m or not self.metric or self.metric not in m:

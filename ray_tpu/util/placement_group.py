@@ -69,7 +69,13 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
     lifetime: Optional[str] = None,
+    priority: Optional[int] = None,
+    tenant: Optional[str] = None,
 ) -> PlacementGroup:
+    """``priority``/``tenant`` override the driver's registered
+    JobConfig identity for this reservation (fairsched): a
+    higher-priority reservation that cannot fit may preempt
+    strictly-lower-priority gangs to claim its chips."""
     if strategy not in VALID_STRATEGIES:
         raise ValueError(f"Invalid strategy {strategy}; must be one of {VALID_STRATEGIES}")
     if not bundles:
@@ -82,7 +88,10 @@ def placement_group(
     from .._private import worker
 
     client = worker.get_client()
-    pg_id = client.create_placement_group([dict(b) for b in bundles], strategy, name)
+    pg_id = client.create_placement_group(
+        [dict(b) for b in bundles], strategy, name,
+        tenant=tenant, priority=priority,
+    )
     return PlacementGroup(PlacementGroupID(pg_id), [dict(b) for b in bundles], strategy)
 
 

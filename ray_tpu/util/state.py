@@ -40,6 +40,17 @@ def list_events(filters: Optional[list] = None) -> List[dict]:
     retries, spills, ...) — the hub's bounded post-mortem log."""
     return _apply_filters(_client().list_state("events"), filters)
 
+def list_jobs(filters: Optional[list] = None) -> List[dict]:
+    """Registered scheduler jobs (fairsched): tenant, priority, quota,
+    submit/dispatch/preemption counters. Distinct from the entrypoint
+    job table (job_submission.JobSubmissionClient.list_jobs)."""
+    return _apply_filters(_client().list_state("jobs"), filters)
+
+def list_tenants(filters: Optional[list] = None) -> List[dict]:
+    """Per-tenant scheduling accounting: quota vs admitted usage,
+    fair-share clock, share of running work, pending_quota depth."""
+    return _apply_filters(_client().list_state("tenants"), filters)
+
 
 def _apply_filters(items: List[dict], filters: Optional[list]) -> List[dict]:
     """filters: [(key, "=" | "!=", value), ...] (reference filter shape)."""

@@ -43,6 +43,68 @@ def test_scales_up_then_down():
         c.shutdown()
 
 
+def test_quota_parked_demand_does_not_scale_up():
+    """fairsched satellite: demand the autoscaler sees is POST-quota —
+    work parked by a tenant's admission quota is flagged
+    pending_quota and must not buy nodes (no amount of hardware can
+    dispatch it)."""
+    from ray_tpu import JobConfig
+    from ray_tpu._private import worker
+    from ray_tpu.autoscaler import NodeProvider
+
+    class RecordingProvider(NodeProvider):
+        def __init__(self):
+            self.created = []
+
+        def create_node(self, node_type):
+            self.created.append(node_type.name)
+            return f"fake-{len(self.created)}"
+
+        def terminate_node(self, node_id):
+            pass
+
+        def non_terminated_nodes(self):
+            return []
+
+    ray_tpu.init(
+        num_cpus=1, max_workers=1, ignore_reinit_error=True,
+        job_config=JobConfig(tenant="capped", quota={"CPU": 1}),
+    )
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        def hold(i):
+            time.sleep(1.5)
+            return i
+
+        refs = [hold.remote(i) for i in range(4)]  # 1 admitted, 3 parked
+        client = worker.get_client()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            demand = client.list_state("demand")
+            running = [
+                t for t in client.list_state("tasks")
+                if t.get("state") == "RUNNING"
+            ]
+            if running and demand:
+                break
+            time.sleep(0.1)
+        assert demand and all(d.get("pending_quota") for d in demand), demand
+        provider = RecordingProvider()
+        scaler = Autoscaler(
+            provider,
+            [NodeTypeConfig("w", {"CPU": 4}, max_workers=3)],
+            upscale_delay_s=0.0,
+        )
+        scaler.step()
+        scaler.step()  # second pass: past any upscale delay
+        assert provider.created == [], (
+            "autoscaler bought nodes for quota-parked demand"
+        )
+        assert ray_tpu.get(refs, timeout=60) == list(range(4))
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_respects_max_workers():
     c = Cluster(head_num_cpus=1, max_workers=1)
     provider = LocalNodeProvider(c)

@@ -1,0 +1,775 @@
+"""Multi-tenant gang scheduler (fairsched): priority queues, fair-share
+quotas, slice-aware preemption.
+
+Unit tests drive the policy engine directly with a deterministic fake
+clock (no wall-time dependence in any priority/fair-share assertion);
+the end-to-end tests run the real hub on a fake (CPU-virtual) cluster:
+a contended 50/50-quota cluster converges to equal chip-time, and a
+priority-10 SLICE reservation preempts a priority-0 gang that later
+completes through the existing retry/restart machinery.
+"""
+
+import itertools
+import json
+import time
+from collections import deque
+from types import SimpleNamespace
+
+import pytest
+
+import ray_tpu
+from ray_tpu import JobConfig
+from ray_tpu._private.fairsched import FairScheduler
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+from ray_tpu.util import state as state_api
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+_seq = itertools.count()
+
+
+def _spec(tenant, resources=None, priority=0, job_id=None):
+    opts = {"tenant": tenant, "priority": priority}
+    if job_id:
+        opts["job_id"] = job_id
+    return SimpleNamespace(
+        task_id=b"t%06d" % next(_seq),
+        resources=dict(resources or {"CPU": 1.0}),
+        options=opts,
+        is_actor_create=False,
+    )
+
+
+def _class_key(tenant, priority=0):
+    # mirrors Hub._sched_class's tail: (..., tenant, priority)
+    return ((("CPU", 1.0),), None, None, "", tenant, priority)
+
+
+# ------------------------------------------------------------ policy units
+
+
+def test_priority_orders_classes_before_fair_share():
+    clock = FakeClock()
+    fs = FairScheduler(clock=clock.now)
+    fs.register_job("ja", tenant="a", priority=0, conn_id=1)
+    fs.register_job("jb", tenant="b", priority=5, conn_id=1)
+    keys = [_class_key("a", 0), _class_key("b", 5)]
+    keys.sort(key=fs.class_order_key)
+    assert keys[0][-2] == "b"  # higher priority first, regardless of usage
+
+
+def test_fair_share_deficit_alternates_with_fake_clock():
+    """One chip, two equal tenants with infinite backlog: the deficit
+    ordering must strictly alternate dispatch (deterministic: all time
+    comes from the fake clock)."""
+    clock = FakeClock()
+    fs = FairScheduler(clock=clock.now)
+    fs.register_job("ja", tenant="a", conn_id=1)
+    fs.register_job("jb", tenant="b", conn_id=1)
+    order = []
+    for _ in range(10):
+        tenant = min(
+            ("a", "b"), key=lambda tn: fs.class_order_key(_class_key(tn))
+        )
+        order.append(tenant)
+        s = _spec(tenant)
+        assert fs.admit(s)
+        fs.charge_dispatch(s)
+        clock.advance(1.0)
+        fs.settle(s.task_id)
+        fs.release_admission(s.task_id)
+    # a starts (tie -> insertion order), then strict alternation
+    assert order == ["a", "b"] * 5
+
+
+def test_fifty_fifty_quota_converges_on_contended_fake_cluster():
+    """Acceptance: two tenants under 50/50 quota on a contended fake
+    4-chip cluster — chip-time per tenant converges within 20% of
+    equal share. Fully simulated on the fake clock."""
+    clock = FakeClock()
+    fs = FairScheduler(clock=clock.now)
+    fs.register_job("ja", tenant="a", quota={"TPU": 2}, conn_id=1)
+    fs.register_job("jb", tenant="b", quota={"TPU": 2}, conn_id=1)
+    backlog = {
+        tn: deque(
+            _spec(tn, {"TPU": 1.0}, job_id="j" + tn) for _ in range(60)
+        )
+        for tn in ("a", "b")
+    }
+    free = 4
+    running = []  # [end_time, spec]
+    chip_seconds = {"a": 0.0, "b": 0.0}
+    runnable: deque = deque()
+    for _ in range(400):
+        runnable.extend(fs.pop_admissible())
+        for tn in ("a", "b"):
+            while backlog[tn]:
+                s = backlog[tn].popleft()
+                if fs.admit(s):
+                    runnable.append(s)
+                else:
+                    break  # parked inside the engine (pending_quota)
+        ordered = sorted(
+            runnable,
+            key=lambda s: fs.class_order_key(
+                _class_key(s.options["tenant"])
+            ),
+        )
+        runnable = deque(ordered)
+        while runnable and free > 0:
+            s = runnable.popleft()
+            free -= 1
+            fs.charge_dispatch(s)
+            running.append([clock.t + 1.0, s])
+        if not running:
+            break
+        nxt = min(end for end, _ in running)
+        clock.advance(nxt - clock.t)
+        done = [r for r in running if r[0] <= clock.t + 1e-9]
+        running = [r for r in running if r[0] > clock.t + 1e-9]
+        for _, s in done:
+            free += 1
+            chip_seconds[s.options["tenant"]] += 1.0
+            fs.settle(s.task_id)
+            fs.release_admission(s.task_id)
+    total = sum(chip_seconds.values())
+    assert total == 120.0  # every queued task ran
+    for tn in ("a", "b"):
+        assert abs(chip_seconds[tn] / total - 0.5) <= 0.2 * 0.5
+
+
+def test_quota_admission_parks_and_readmits():
+    clock = FakeClock()
+    fs = FairScheduler(clock=clock.now)
+    fs.register_job("j", tenant="t", quota={"CPU": 2}, conn_id=1)
+    s1, s2, s3 = (_spec("t") for _ in range(3))
+    assert fs.admit(s1) and fs.admit(s2)
+    assert not fs.admit(s3)  # over quota: parked
+    assert fs.parked_count() == 1
+    assert fs.pop_admissible() == []  # still over
+    fs.release_admission(s1.task_id)
+    assert fs.pop_admissible() == [s3]  # room freed -> re-admitted FIFO
+    assert fs.parked_count() == 0
+    # idempotent: double release must not under-count
+    fs.release_admission(s1.task_id)
+    fs.release_admission(s2.task_id)
+    fs.release_admission(s3.task_id)
+    assert all(
+        v <= 1e-9 for v in fs.tenants["t"].admitted.values()
+    )
+
+
+def test_infeasible_request_rejected_loudly():
+    """A request bigger than the quota itself can never be admitted:
+    admit() raises instead of parking it forever (and wedging the
+    tenant's FIFO queue behind it)."""
+    from ray_tpu._private.fairsched import QuotaInfeasibleError
+
+    fs = FairScheduler()
+    fs.register_job("j", tenant="t", quota={"TPU": 4}, conn_id=1)
+    with pytest.raises(QuotaInfeasibleError):
+        fs.admit(_spec("t", {"TPU": 8}))
+    assert fs.parked_count() == 0
+    # a later quota drop strands parked-but-now-infeasible work:
+    # pop_infeasible surfaces it for loud failure
+    big = _spec("t", {"TPU": 4})
+    small = _spec("t", {"TPU": 4})
+    assert fs.admit(big)
+    assert not fs.admit(small)  # parked (feasible, just contended)
+    fs.register_job("j", tenant="t", quota={"TPU": 2}, conn_id=1)
+    assert fs.pop_infeasible("t") == [small]
+    assert fs.parked_count() == 0
+
+
+def test_quota_tristate_on_reregistration():
+    fs = FairScheduler()
+    fs.register_job("j1", tenant="t", quota={"CPU": 2}, conn_id=1)
+    fs.register_job("j2", tenant="t", quota=None, conn_id=1)
+    assert fs.tenants["t"].quota == {"CPU": 2.0}  # None = no opinion
+    fs.register_job("j3", tenant="t", quota={}, conn_id=1)
+    assert fs.tenants["t"].quota == {}  # {} lifts the cap
+
+
+def test_drop_conn_prunes_job_registry():
+    fs = FairScheduler()
+    fs.register_job("j1", tenant="a", conn_id=11)
+    fs.register_job("j2", tenant="b", conn_id=22)
+    assert fs.drop_conn(11) == ["j1"]
+    assert list(fs.jobs) == ["j2"]
+    # idle tenant of the dropped job is gone too (no admitted/parked)
+    assert "a" not in fs.tenants and "b" in fs.tenants
+    # a tenant still holding parked work survives its registering conn
+    fs.tenants["b"].quota = {"CPU": 1}
+    running = _spec("b", {"CPU": 1})
+    parked = _spec("b", {"CPU": 1})
+    assert fs.admit(running)
+    assert not fs.admit(parked)  # feasible but contended: parks
+    fs.drop_conn(22)
+    assert "b" in fs.tenants and fs.parked_count() == 1
+
+
+def test_settle_pops_running_even_after_tenant_drop():
+    """Driver churn must not leak fair-share intervals: settle() pops
+    the _running entry even when the tenant was already pruned."""
+    fs = FairScheduler()
+    fs.register_job("j", tenant="x", conn_id=1)
+    s = _spec("x")
+    assert fs.admit(s)
+    fs.charge_dispatch(s)
+    assert s.task_id in fs._running
+    fs.drop_conn(1)  # tenant pruned (no quota, nothing parked)
+    assert "x" not in fs.tenants
+    fs.settle(s.task_id)
+    assert not fs._running
+
+
+def _pg(priority, seq, chips, bundles=None, node="node0"):
+    return SimpleNamespace(
+        priority=priority, seq=seq,
+        bundle_chips=[tuple(range(chips))] if chips else [],
+        bundle_nodes=[node] if chips else [],
+        bundles=bundles or [{"TPU": float(chips)}],
+    )
+
+
+def test_preemption_victim_selection():
+    fs = FairScheduler()
+    low_old = _pg(0, 1, 4)
+    low_new = _pg(0, 2, 4)
+    mid = _pg(5, 3, 4)
+    high = _pg(9, 4, 4)
+    nodes = {"node0": {}}
+    # need 4 chips, 0 free: one gang suffices — lowest priority bleeds
+    # first, and within a priority the NEWEST gang dies first
+    pgs, tasks = fs.preemption_victims(
+        10, 4, {"TPU": 4.0}, {"TPU": 4.0},
+        [low_old, low_new, mid, high], [], {"node0": 0}, nodes)
+    assert pgs == [low_new] and tasks == []
+    # a bigger gap takes whole gangs in order, never partial
+    pgs, _ = fs.preemption_victims(
+        10, 12, {"TPU": 4.0}, {"TPU": 12.0},
+        [low_old, low_new, mid, high], [], {"node0": 0}, nodes)
+    assert pgs == [low_new, low_old, mid]
+    # equal/higher priority is never a victim; infeasible -> no-op
+    pgs, tasks = fs.preemption_victims(
+        5, 4, {"TPU": 4.0}, {"TPU": 4.0}, [mid, high], [],
+        {"node0": 0}, nodes)
+    assert pgs == [] and tasks == []
+
+
+def test_preemption_is_node_aware():
+    """Two 2-chip victims on DIFFERENT hosts cannot seat a 4-chip
+    single-node bundle: shedding them would be work lost for naught,
+    so nothing is preempted."""
+    fs = FairScheduler()
+    va = _pg(0, 1, 2, node="nodeA")
+    vb = _pg(0, 2, 2, node="nodeB")
+    nodes = {"nodeA": {}, "nodeB": {}}
+    pgs, tasks = fs.preemption_victims(
+        10, 4, {"TPU": 4.0}, {"TPU": 4.0}, [va, vb], [],
+        {"nodeA": 0, "nodeB": 0}, nodes)
+    assert pgs == [] and tasks == []
+    # same victims CAN seat two 2-chip bundles (one per host)
+    pgs, _ = fs.preemption_victims(
+        10, 4, {"TPU": 2.0}, {"TPU": 4.0}, [va, vb], [],
+        {"nodeA": 0, "nodeB": 0}, nodes)
+    assert set(id(p) for p in pgs) == {id(va), id(vb)}
+
+
+def test_non_slice_tpu_gangs_are_preemptable():
+    """PACK/SPREAD TPU gangs have no bundle_chips (only SLICE reserves
+    specific chips), but killing them still frees their chips — the
+    feasibility model must credit the bundle's TPU request."""
+    fs = FairScheduler()
+    pack_gang = SimpleNamespace(
+        priority=0, seq=1, bundle_chips=[],  # non-SLICE: no chunks
+        bundle_nodes=["node0"], bundles=[{"TPU": 8.0}],
+    )
+    pgs, tasks = fs.preemption_victims(
+        10, 8, {"TPU": 8.0}, {"TPU": 8.0}, [pack_gang], [],
+        {"node0": 0}, {"node0": {}})
+    assert pgs == [pack_gang] and tasks == []
+
+
+def test_single_task_victims_bleed_before_gangs():
+    """Within a priority, one task retry loses less work than a whole
+    gang restart: the task is taken first when it alone closes the
+    gap."""
+    fs = FairScheduler()
+    gang = _pg(0, 1, 4)
+    worker = SimpleNamespace(pinned_chips=(0, 1, 2, 3), node_id="node0")
+    spec = SimpleNamespace(
+        task_id=b"tv", resources={"TPU": 4.0},
+        options={"tenant": "t", "priority": 0}, is_actor_create=False,
+    )
+    pgs, tasks = fs.preemption_victims(
+        10, 4, {"TPU": 4.0}, {"TPU": 4.0}, [gang], [(worker, spec)],
+        {"node0": 0}, {"node0": {}})
+    assert pgs == [] and tasks == [(worker, spec)]
+
+
+def test_usage_decays_and_newcomers_start_at_baseline():
+    """A tenant's hour of solo usage must not starve it once a
+    competitor registers: usage decays (10-min half-life) and a new
+    tenant enters at the lowest incumbent's level, not zero."""
+    clock = FakeClock()
+    fs = FairScheduler(clock=clock.now)
+    fs.register_job("ja", tenant="a", conn_id=1)
+    s = _spec("a", {"TPU": 4.0})
+    assert fs.admit(s)
+    fs.charge_dispatch(s)
+    clock.advance(3600.0)  # tenant a runs alone for an hour
+    fs.settle(s.task_id)
+    fs.release_admission(s.task_id)
+    fs.register_job("jb", tenant="b", conn_id=1)
+    ua = fs.tenants["a"].live_usage(clock.now())
+    ub = fs.tenants["b"].live_usage(clock.now())
+    # newcomer starts at the incumbent's level: no catch-up monopoly
+    assert ub == pytest.approx(ua)
+    ordered = sorted(("a", "b"), key=lambda tn: fs.class_order_key(_class_key(tn)))
+    assert ordered[0] == "a"  # tie broken stably, not b-first-for-an-hour
+    # and the history itself fades: two half-lives -> a quarter left
+    clock.advance(1200.0)
+    assert fs.tenants["a"].live_usage(clock.now()) == pytest.approx(
+        ua * 0.25
+    )
+
+
+def test_pg_reservations_count_against_quota():
+    """Placement-group reservations hold chips exclusively, so they
+    charge the tenant's quota at creation (fail-fast when over), and
+    tasks placed INTO the PG are exempt (no double counting)."""
+    fs = FairScheduler()
+    fs.register_job("j", tenant="t", quota={"TPU": 4}, conn_id=1)
+    assert fs.charge_reservation(b"pg1", "t", {"TPU": 4.0}) is None
+    err = fs.charge_reservation(b"pg2", "t", {"TPU": 2.0})
+    assert err is not None and "quota" in err
+    # a task running inside the PG does not re-charge the quota
+    inside = SimpleNamespace(
+        task_id=b"ti", resources={"TPU": 2.0},
+        options={"tenant": "t", "placement_group": (b"pg1", 0)},
+        is_actor_create=False,
+    )
+    assert fs.admit(inside)
+    # removal releases the reservation; the next PG fits again
+    fs.release_admission(b"pg1")
+    assert fs.charge_reservation(b"pg2", "t", {"TPU": 2.0}) is None
+
+
+def test_release_admission_prunes_orphaned_tenants():
+    """A conn dropping with work in flight keeps its tenant only until
+    that work finishes — then the tenant (and its accounting) goes."""
+    fs = FairScheduler()
+    fs.register_job("j", tenant="t", quota={"CPU": 2}, conn_id=1)
+    s = _spec("t")
+    assert fs.admit(s)
+    fs.drop_conn(1)
+    assert "t" in fs.tenants  # admitted work still in flight
+    fs.release_admission(s.task_id)
+    assert "t" not in fs.tenants  # fully idle + job-less: pruned
+
+
+def test_preemption_requires_resource_colocation():
+    """The largest bundle's chips AND its other resources must land on
+    one node: freeing CPU on a different host than the chips does not
+    make {TPU:4, CPU:8} schedulable, so nothing is preempted."""
+    fs = FairScheduler()
+    chip_victim = _pg(0, 1, 4, bundles=[{"TPU": 4.0}], node="nodeA")
+    cpu_victim = SimpleNamespace(
+        priority=0, seq=2, bundle_chips=[()], bundle_nodes=["nodeB"],
+        bundles=[{"CPU": 8.0}],
+    )
+    need = {"TPU": 4.0, "CPU": 8.0}
+    nodes = {"nodeA": {"CPU": 0.0}, "nodeB": {"CPU": 0.0}}
+    pgs, tasks = fs.preemption_victims(
+        10, 4, need, need, [chip_victim, cpu_victim], [],
+        {"nodeA": 0, "nodeB": 0}, nodes)
+    assert pgs == [] and tasks == []
+    # with the CPU freed on the SAME node as the chips, it works
+    cpu_victim_a = SimpleNamespace(
+        priority=0, seq=2, bundle_chips=[()], bundle_nodes=["nodeA"],
+        bundles=[{"CPU": 8.0}],
+    )
+    pgs, _ = fs.preemption_victims(
+        10, 4, need, need, [chip_victim, cpu_victim_a], [],
+        {"nodeA": 0, "nodeB": 0}, nodes)
+    assert set(id(p) for p in pgs) == {id(chip_victim), id(cpu_victim_a)}
+
+
+def test_new_arrivals_do_not_bypass_parked_queue():
+    """FIFO re-admission: once a big task is parked, later small tasks
+    from the same tenant park behind it instead of slipping into every
+    freed slot and starving the head."""
+    clock = FakeClock()
+    fs = FairScheduler(clock=clock.now)
+    fs.register_job("j", tenant="t", quota={"CPU": 2}, conn_id=1)
+    s1 = _spec("t", {"CPU": 1})
+    s2 = _spec("t", {"CPU": 1})
+    big = _spec("t", {"CPU": 2})
+    small = _spec("t", {"CPU": 1})
+    assert fs.admit(s1) and fs.admit(s2)
+    assert not fs.admit(big)     # over quota: parked
+    assert not fs.admit(small)   # would fit a freed slot, but FIFO parks it
+    fs.release_admission(s1.task_id)
+    assert fs.pop_admissible() == []  # head needs 2 CPU; only 1 free
+    fs.release_admission(s2.task_id)
+    # strict queue order: big admits first and consumes the quota;
+    # small stays parked until big finishes
+    assert fs.pop_admissible() == [big]
+    fs.release_admission(big.task_id)
+    assert fs.pop_admissible() == [small]
+
+
+# ------------------------------------------------------------- hub E2E
+
+
+@pytest.fixture
+def shutdown_ray():
+    yield
+    ray_tpu.shutdown()
+
+
+def _client():
+    from ray_tpu._private import worker
+
+    return worker.get_client()
+
+
+def test_blocked_class_does_not_stall_other_classes(shutdown_ray):
+    """Satellite regression: a scheduling class whose head task is
+    unplaceable (999 chips on a chipless cluster) must not prevent
+    same-priority tasks in other classes from dispatching in the same
+    scheduler pass."""
+    ray_tpu.init(num_cpus=2, num_tpus=0, max_workers=2,
+                 ignore_reinit_error=True)
+
+    @ray_tpu.remote(num_tpus=999, num_cpus=0)
+    def impossible():
+        return "never"
+
+    @ray_tpu.remote(num_cpus=0)
+    def light(i):
+        return i
+
+    blocked = impossible.remote()
+    out = ray_tpu.get([light.remote(i) for i in range(8)], timeout=60)
+    assert out == list(range(8))
+    ray_tpu.cancel(blocked)
+
+
+def test_quota_parks_pending_quota_then_completes(shutdown_ray):
+    ray_tpu.init(
+        num_cpus=4, max_workers=4, ignore_reinit_error=True,
+        job_config=JobConfig(tenant="capped", quota={"CPU": 1}),
+    )
+
+    @ray_tpu.remote(num_cpus=1)
+    def step(i):
+        time.sleep(0.2)
+        return i
+
+    refs = [step.remote(i) for i in range(4)]
+    # with a 1-CPU quota on a 4-CPU cluster, some tasks must park
+    deadline = time.monotonic() + 30
+    saw_parked = False
+    while time.monotonic() < deadline and not saw_parked:
+        tenants = {t["tenant"]: t for t in state_api.list_tenants()}
+        saw_parked = tenants.get("capped", {}).get("pending_quota", 0) > 0
+        time.sleep(0.05)
+    assert saw_parked, "no task ever parked as pending_quota"
+    # parked demand is flagged so the autoscaler ignores it
+    parked_demand = [
+        d for d in _client().list_state("demand") if d.get("pending_quota")
+    ]
+    assert parked_demand and all(
+        d["shape"] == {"CPU": 1.0} for d in parked_demand
+    )
+    # quota is a throttle, not a wall: everything still completes
+    assert ray_tpu.get(refs, timeout=60) == list(range(4))
+    tenants = {t["tenant"]: t for t in state_api.list_tenants()}
+    assert tenants["capped"]["pending_quota"] == 0
+
+
+def test_nested_submits_inherit_job_identity(shutdown_ray):
+    """Quota must not be escapable by fanning out subtasks: a task
+    submitted from INSIDE a worker inherits the driver's tenant, so
+    nested work is admitted against the same quota and accounted to
+    the same tenant."""
+    ray_tpu.init(
+        num_cpus=4, max_workers=4, ignore_reinit_error=True,
+        job_config=JobConfig(
+            tenant="nested", quota={"CPU": 2}, job_id="job-nested"
+        ),
+    )
+
+    @ray_tpu.remote(num_cpus=1)
+    def inner(i):
+        time.sleep(0.1)
+        return i
+
+    @ray_tpu.remote(num_cpus=1)
+    def outer(n):
+        return ray_tpu.get([inner.remote(i) for i in range(n)])
+
+    assert ray_tpu.get(outer.remote(4), timeout=60) == list(range(4))
+    jobs = {j["job_id"]: j for j in state_api.list_jobs()}
+    # 1 outer + 4 nested inner submits all accounted to the job
+    assert jobs["job-nested"]["submitted"] == 5
+    # with outer holding 1 CPU of the 2-CPU quota, inner tasks were
+    # throttled through admission (at most 1 concurrent): some parked
+    tenants = {t["tenant"]: t for t in state_api.list_tenants()}
+    assert tenants["nested"]["pending_quota"] == 0  # all drained
+
+
+def test_infeasible_submit_fails_instead_of_hanging(shutdown_ray):
+    ray_tpu.init(
+        num_cpus=4, max_workers=2, ignore_reinit_error=True,
+        job_config=JobConfig(tenant="tiny", quota={"CPU": 1}),
+    )
+
+    @ray_tpu.remote(num_cpus=2)
+    def too_big():
+        return 1
+
+    with pytest.raises(Exception, match="never be admitted"):
+        ray_tpu.get(too_big.remote(), timeout=30)
+
+
+def test_killing_quota_parked_actor_unparks_it(shutdown_ray):
+    ray_tpu.init(
+        num_cpus=2, max_workers=2, ignore_reinit_error=True,
+        job_config=JobConfig(tenant="capped", quota={"CPU": 1}),
+    )
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold():
+        time.sleep(1.0)
+        return 1
+
+    @ray_tpu.remote(num_cpus=1)
+    class Parked:
+        def ping(self):
+            return "pong"
+
+    blocker = hold.remote()
+    # quota is fully admitted by the task: the creation must park
+    actor = Parked.remote()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        tenants = {t["tenant"]: t for t in state_api.list_tenants()}
+        if tenants.get("capped", {}).get("pending_quota", 0) > 0:
+            break
+        time.sleep(0.05)
+    assert tenants["capped"]["pending_quota"] == 1
+    ray_tpu.kill(actor)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        tenants = {t["tenant"]: t for t in state_api.list_tenants()}
+        if tenants["capped"]["pending_quota"] == 0:
+            break
+        time.sleep(0.05)
+    assert tenants["capped"]["pending_quota"] == 0, (
+        "killed parked actor creation must leave the pending_quota queue"
+    )
+    assert ray_tpu.get(blocker, timeout=30) == 1
+
+
+def test_two_tenant_dispatch_interleaves(shutdown_ray):
+    """One worker, tenant A floods the queue before tenant B: fair-share
+    ordering must interleave completions instead of draining A first."""
+    ray_tpu.init(num_cpus=1, max_workers=1, ignore_reinit_error=True)
+    cl = _client()
+    cl.register_job("job-a", tenant="ta")
+    cl.register_job("job-b", tenant="tb")
+
+    @ray_tpu.remote(num_cpus=1)
+    def work_a(i):
+        time.sleep(0.05)
+        return i
+
+    @ray_tpu.remote(num_cpus=1)
+    def work_b(i):
+        time.sleep(0.05)
+        return i
+
+    # warm the single worker so spawn latency doesn't skew the order
+    ray_tpu.get(work_a.options(tenant="ta").remote(-1))
+    refs_a = [work_a.options(tenant="ta").remote(i) for i in range(8)]
+    refs_b = [work_b.options(tenant="tb").remote(i) for i in range(8)]
+    ray_tpu.get(refs_a + refs_b, timeout=120)
+    events = [
+        e for e in state_api.list_tasks()
+        if e.get("state") == "FINISHED" and e.get("t_finished")
+        and e.get("name", "").startswith("work_")
+    ]
+    events.sort(key=lambda e: e["t_finished"])
+    first_half = [e["name"].split(":")[0] for e in events[:8]]
+    # FIFO would put all 8 work_a first; fair share interleaves
+    assert first_half.count("work_b") >= 3, first_half
+
+
+def test_priority_jumps_the_queue(shutdown_ray):
+    ray_tpu.init(num_cpus=1, max_workers=1, ignore_reinit_error=True)
+
+    @ray_tpu.remote(num_cpus=1)
+    def stamp(tag):
+        time.sleep(0.05)
+        return (tag, time.monotonic())
+
+    ray_tpu.get(stamp.remote("warm"))  # one live worker, now idle
+    blocker = stamp.remote("blocker")
+    low = [stamp.options(priority=0).remote(f"low{i}") for i in range(3)]
+    high = stamp.options(priority=7).remote("high")
+    results = dict(
+        t for t in ray_tpu.get(low + [high, blocker], timeout=60)
+        if t[0] != "blocker"
+    )
+    assert results["high"] < min(v for k, v in results.items()
+                                 if k.startswith("low")), results
+
+
+def test_slice_preemption_end_to_end(shutdown_ray, monkeypatch):
+    """Acceptance: a priority-10 SLICE reservation preempts a
+    priority-0 gang (whole gang, paired preemption/task_retry events),
+    and the preempted gang requeues and completes after the
+    high-priority job finishes."""
+    monkeypatch.setenv("TPU_TOPOLOGY", "1x8")
+    ray_tpu.init(num_cpus=8, num_tpus=8, max_workers=8,
+                 ignore_reinit_error=True)
+
+    @ray_tpu.remote(num_tpus=2, num_cpus=0, max_retries=0)
+    def gang_task(i):
+        time.sleep(3)
+        return f"low-{i}"
+
+    pg_low = placement_group(
+        [{"TPU": 2}] * 4, strategy="SLICE", priority=0, tenant="teamA"
+    )
+    assert pg_low.wait(15)
+    victims = [
+        gang_task.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg_low, i)
+        ).remote(i)
+        for i in range(4)
+    ]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        running = [
+            t for t in state_api.list_tasks() if t.get("state") == "RUNNING"
+        ]
+        if len(running) >= 4:
+            break
+        time.sleep(0.2)
+    assert len(running) >= 4, "victim gang never fully started"
+
+    pg_high = placement_group(
+        [{"TPU": 8}], strategy="SLICE", priority=10, tenant="teamB"
+    )
+    assert pg_high.wait(30), "priority-10 SLICE failed to preempt"
+
+    events = state_api.list_events()
+    pre = [e for e in events if e["kind"] == "preemption"]
+    assert pre, "no preemption event recorded"
+    assert pre[0]["by_priority"] == 10 and pre[0]["priority"] == 0
+    retried = [
+        e for e in events
+        if e["kind"] == "task_retry" and e.get("reason") == "preempted"
+    ]
+    assert len(retried) == 4, "whole gang must requeue (never partial)"
+
+    @ray_tpu.remote(num_tpus=8, num_cpus=0)
+    def high_job():
+        return "high done"
+
+    assert ray_tpu.get(
+        high_job.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(pg_high, 0)
+        ).remote(),
+        timeout=60,
+    ) == "high done"
+    remove_placement_group(pg_high)
+
+    # the victim gang re-reserves its slice and completes successfully
+    assert sorted(ray_tpu.get(victims, timeout=120)) == [
+        f"low-{i}" for i in range(4)
+    ]
+    metrics = {
+        m["name"]: m for m in _client().list_state("metrics")
+    }
+    assert metrics["ray_tpu_sched_preemptions_total"]["value"] >= 1
+
+
+def test_preempted_actor_restarts_via_actor_restart_path(
+    shutdown_ray, monkeypatch
+):
+    monkeypatch.setenv("TPU_TOPOLOGY", "1x4")
+    ray_tpu.init(num_cpus=4, num_tpus=4, max_workers=4,
+                 ignore_reinit_error=True)
+
+    @ray_tpu.remote(num_tpus=4, num_cpus=0, max_restarts=0)
+    class GangMember:
+        def ping(self):
+            return "pong"
+
+    pg_low = placement_group([{"TPU": 4}], strategy="SLICE", priority=0)
+    assert pg_low.wait(15)
+    actor = GangMember.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(pg_low, 0)
+    ).remote()
+    assert ray_tpu.get(actor.ping.remote(), timeout=60) == "pong"
+
+    pg_high = placement_group([{"TPU": 4}], strategy="SLICE", priority=10)
+    assert pg_high.wait(30)
+    events = state_api.list_events()
+    assert any(e["kind"] == "preemption" for e in events)
+    # preemption must not burn the restart budget: max_restarts=0 still
+    # restarts through the existing actor_restart path
+    assert any(e["kind"] == "actor_restart" for e in events)
+    remove_placement_group(pg_high)
+    assert ray_tpu.get(actor.ping.remote(), timeout=120) == "pong"
+
+
+def test_jobs_cli_and_dashboard_tables(shutdown_ray, capsys, monkeypatch):
+    ctx = ray_tpu.init(
+        num_cpus=2, max_workers=2, ignore_reinit_error=True,
+        job_config=JobConfig(
+            tenant="cliteam", priority=3, quota={"CPU": 2}, job_id="job-cli"
+        ),
+    )
+
+    @ray_tpu.remote(num_cpus=1)
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote())
+    jobs = state_api.list_jobs()
+    assert jobs and jobs[0]["job_id"] == "job-cli"
+    assert jobs[0]["tenant"] == "cliteam" and jobs[0]["priority"] == 3
+    assert jobs[0]["dispatched"] >= 1
+
+    from ray_tpu.scripts import main as cli_main
+
+    monkeypatch.setenv("RAY_TPU_ADDRESS", ctx.address_info["address"])
+    cli_main(["jobs", "--format", "json"])
+    doc = json.loads(capsys.readouterr().out)
+    tenants = {t["tenant"]: t for t in doc["tenants"]}
+    assert tenants["cliteam"]["quota"] == {"CPU": 2.0}
+    assert any(j["job_id"] == "job-cli" for j in doc["jobs"])
+    # table mode renders too
+    cli_main(["jobs"])
+    out = capsys.readouterr().out
+    assert "cliteam" in out and "job-cli" in out

@@ -996,6 +996,127 @@ def test_reverting_hub_timeline_wall_duration_is_flagged():
     assert "GL008" in codes_of(src, path="ray_tpu/_private/hub.py")
 
 
+# --------------------------------------------------------------------- GL009
+
+
+def test_gl009_flags_handler_registry_without_prune():
+    # the hub-registry leak shape: a message handler inserts into a
+    # dict born empty in __init__, and no method ever removes entries
+    src = """
+    class Hub:
+        def __init__(self):
+            self.jobs = {}
+
+        def _on_register_job(self, conn, p):
+            self.jobs[p["job_id"]] = (p["tenant"], p["priority"])
+    """
+    assert "GL009" in codes_of(src)
+
+
+def test_gl009_flags_setdefault_and_append_growth():
+    src = """
+    class Hub:
+        def __init__(self):
+            self.waiters = {}
+            self.log = []
+
+        def _on_wait(self, conn, p):
+            self.waiters.setdefault(p["oid"], []).append(conn)
+
+        def _on_note(self, conn, p):
+            self.log.append(p)
+    """
+    assert "GL009" in codes_of(src)
+
+
+def test_gl009_clean_when_disconnect_prunes():
+    src = """
+    class Hub:
+        def __init__(self):
+            self.jobs = {}
+
+        def _on_register_job(self, conn, p):
+            self.jobs[p["job_id"]] = (p["tenant"], p["priority"])
+
+        def _handle_disconnect(self, conn):
+            for job_id in [j for j, e in self.jobs.items() if e[0] == conn]:
+                self.jobs.pop(job_id, None)
+    """
+    assert "GL009" not in codes_of(src)
+
+
+def test_gl009_clean_when_del_or_reassigned():
+    src = """
+    class Hub:
+        def __init__(self):
+            self.table = {}
+
+        def _on_put(self, conn, p):
+            self.table[p["k"]] = p["v"]
+
+        def _gc(self):
+            for k in self._expired():
+                del self.table[k]
+    """
+    assert "GL009" not in codes_of(src)
+
+
+def test_gl009_ignores_non_handler_growth_and_seeded_tables():
+    # growth outside _on_*/register_* methods has its own lifecycle;
+    # tables seeded non-empty are static maps, not request registries
+    src = """
+    class Client:
+        def __init__(self):
+            self.cache = {}
+            self.nodes = {"node0": object()}
+
+        def get(self, k, v):
+            self.cache[k] = v
+
+        def _on_register_node(self, conn, p):
+            self.nodes[p["node_id"]] = p
+    """
+    assert "GL009" not in codes_of(src)
+
+
+def test_reverting_fairsched_job_registry_prune_is_flagged():
+    """The PR-5 JobEntry registry: FairScheduler.register_job inserts
+    into self.jobs and drop_conn (wired into the hub's disconnect
+    path) prunes it. Removing the prune must trip GL009."""
+    src = """
+    class FairScheduler:
+        def __init__(self, clock=None):
+            self.jobs = {}
+            self.tenants = {}
+
+        def register_job(self, job_id, tenant, priority, quota, conn_id):
+            entry = self.jobs[job_id] = (tenant, priority, quota, conn_id)
+            return entry
+
+        def drop_conn(self, conn_id):
+            return []  # prune removed: the registry now grows forever
+    """
+    assert "GL009" in codes_of(src)
+    # ...and the shipped shape (drop_conn deletes by conn id) is clean
+    fixed = """
+    class FairScheduler:
+        def __init__(self, clock=None):
+            self.jobs = {}
+            self.tenants = {}
+
+        def register_job(self, job_id, tenant, priority, quota, conn_id):
+            entry = self.jobs[job_id] = (tenant, priority, quota, conn_id)
+            return entry
+
+        def drop_conn(self, conn_id):
+            gone = [j for j, e in self.jobs.items() if e[3] == conn_id]
+            for job_id in gone:
+                del self.jobs[job_id]
+            return gone
+    """
+    assert "GL009" not in codes_of(fixed)
+
+
 # ------------------------------------------------------------- repo gate
 
 
@@ -1019,5 +1140,5 @@ def test_every_checker_is_exercised_by_the_gate_config():
     codes = {code for code, _name, _fn in all_checkers()}
     assert codes == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008",
+        "GL008", "GL009",
     }
