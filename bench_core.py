@@ -54,6 +54,15 @@ BASELINES = {
     "single_client_put_calls": 4968.0,
     "single_client_put_gigabytes": 19.4,
     "single_client_wait_1k_refs": 4.77,
+    # net-new rows (no reference analogue), baselines measured on this
+    # repo's 1-core CI box at their introduction (PR 6):
+    # - put_gigabytes_direct: a shm-less client streaming large puts
+    #   over the out-of-band object plane (object_agent direct put)
+    #   instead of the hub-relay PUT_CHUNK path
+    # - wait_1k_refs_push: one wait(num_returns=1000) served by the
+    #   readiness-push subscription (SUBSCRIBE_READY/READY_PUSH)
+    "single_client_put_gigabytes_direct": 1.0,
+    "single_client_wait_1k_refs_push": 2.5,
     "placement_group_create_removal": 752.0,
 }
 
@@ -328,6 +337,23 @@ def main() -> None:
 
     report("single_client_wait_1k_refs", timeit(wait_1k, warmup=0), "ops/s")
 
+    def wait_1k_push():
+        # readiness-push-native shape: ONE wait for the full set — a
+        # single SUBSCRIBE_READY round trip plus hub pushes, no
+        # pop-loop re-asks (PR 6 out-of-band object plane)
+        n = 1 if QUICK else 3
+        for _ in range(n):
+            count = 100 if SMOKE else 1000
+            refs = [nullary.remote() for _ in range(count)]
+            ready, _ = ray_tpu.wait(refs, num_returns=count, timeout=60)
+            assert len(ready) == count
+        return n
+
+    report(
+        "single_client_wait_1k_refs_push", timeit(wait_1k_push, warmup=0),
+        "ops/s",
+    )
+
     # ---- placement groups
     from ray_tpu.util.placement_group import (
         placement_group,
@@ -366,6 +392,12 @@ def main() -> None:
 
     report("scheduler_contention", timeit(sched_contention), "tasks/s")
 
+    if SMOKE:
+        # smoke must still report every BASELINES row: exercise the
+        # direct-put plane in-process against this session's head agent
+        # (numbers NOT comparable to quick/full subprocess runs)
+        _smoke_direct_put_row()
+
     ray_tpu.shutdown()
 
     if not SMOKE:
@@ -394,21 +426,55 @@ def main() -> None:
             f.write("\n")
 
 
-def _bench_client_mode() -> None:
-    # ---- client-mode object plane (no reference baseline: the
-    # reference's client microbenchmarks aren't in BASELINE.md; the row
-    # documents the chunk-streaming path's throughput)
+def _smoke_direct_put_row() -> None:
+    """Tiny in-process direct put for the --smoke BASELINES contract
+    (a scratch shm-less client streaming to this session's object
+    agent — same code path as the quick/full subprocess row)."""
+    import tempfile
+    import time as _time
+    import uuid
+
+    import numpy as np
+
+    from ray_tpu._private import worker as w
+    from ray_tpu._private.client import CoreClient
+
+    hub = w._hub
+    scratch = os.path.join(
+        tempfile.gettempdir(), f"rt_bench_{uuid.uuid4().hex[:8]}"
+    )
+    os.makedirs(scratch, exist_ok=True)
+    cl = CoreClient(hub.addr, scratch, role="client",
+                    worker_id="bench_smoke_client")
+    cl.inline_only = True
+    cl.hostname = "bench-smoke-remote"  # force the socket path
+    try:
+        big = np.random.randint(0, 256, (4 * 1024 * 1024,), dtype=np.uint8)
+        cl.free([cl.put_value(big)])  # warm the path
+        t0 = _time.perf_counter()
+        n = 4
+        for _ in range(n):
+            cl.free([cl.put_value(big)])
+        dt = _time.perf_counter() - t0
+        report(
+            "single_client_put_gigabytes_direct",
+            n * big.nbytes / (1024 ** 3) / dt, "GiB/s",
+        )
+    finally:
+        cl.close()
+
+
+def _client_put_rate(address: str, env_extra: dict) -> float:
+    """One shm-less client subprocess streaming large puts; returns
+    GiB/s (the direct plane or the hub relay, per env_extra)."""
     import subprocess
 
-    import ray_tpu
-
-    ctx = ray_tpu.init(num_cpus=2, max_workers=2, _tcp_hub=True)
     script = f"""
 import sys; sys.path.insert(0, {json.dumps(os.path.dirname(os.path.abspath(__file__)))})
 import time
 import numpy as np
 import ray_tpu
-ray_tpu.init(address={json.dumps(ctx.address_info["address"])})
+ray_tpu.init(address={json.dumps(address)})
 big = np.random.randint(0, 256, (64 * 1024 * 1024,), dtype=np.uint8)
 ray_tpu.free([ray_tpu.put(big)])  # warm the path
 n = {2 if QUICK else 8}
@@ -419,18 +485,43 @@ dt = time.perf_counter() - t0
 print("RATE", n * big.nbytes / (1024 ** 3) / dt)
 ray_tpu.shutdown()
 """
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True,
+        text=True, timeout=300, env={**os.environ, **env_extra},
+    )
+    return next(
+        float(line.split()[1]) for line in out.stdout.splitlines()
+        if line.startswith("RATE")
+    )
+
+
+def _bench_client_mode() -> None:
+    # ---- client-mode object plane (the direct row has a this-box
+    # baseline; the relay row keeps its original no-baseline provenance
+    # — it documents the PUT_CHUNK hub-relay path the direct plane
+    # falls back to)
+    import ray_tpu
+
+    ctx = ray_tpu.init(num_cpus=2, max_workers=2, _tcp_hub=True)
+    addr = ctx.address_info["address"]
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", script], capture_output=True,
-            text=True, timeout=300,
-        )
-        rate = next(
-            float(line.split()[1]) for line in out.stdout.splitlines()
-            if line.startswith("RATE")
-        )
-        report("client_put_gigabytes", rate, "GiB/s")
-    except Exception as e:  # noqa: BLE001
-        print(f"client_put_gigabytes failed: {e}", file=sys.stderr)
+        try:
+            report(
+                "single_client_put_gigabytes_direct",
+                _client_put_rate(addr, {"RAY_TPU_OBJECT_DIRECT": "1"}),
+                "GiB/s",
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"single_client_put_gigabytes_direct failed: {e}",
+                  file=sys.stderr)
+        try:
+            report(
+                "client_put_gigabytes",
+                _client_put_rate(addr, {"RAY_TPU_OBJECT_DIRECT": "0"}),
+                "GiB/s",
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"client_put_gigabytes failed: {e}", file=sys.stderr)
     finally:
         ray_tpu.shutdown()
 

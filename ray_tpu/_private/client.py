@@ -61,6 +61,14 @@ class CoreClient:
         self.worker_id = worker_id
         self.session_dir = session_dir
         self.node_id = os.environ.get("RAY_TPU_NODE_ID", "node0")
+        # effective hostname for same-host transfer decisions: the
+        # simulated-cluster harness fakes per-node hostnames, so two
+        # "nodes" on one machine still exercise the socket path
+        import socket as _socket
+
+        self.hostname = (
+            os.environ.get("RAY_TPU_NODE_HOSTNAME") or _socket.gethostname()
+        )
         self.store = ShmObjectStore(session_dir)
         self.conn = connect_hub(hub_addr)
         self._send_lock = threading.Lock()
@@ -88,6 +96,39 @@ class CoreClient:
         # connection, large ones chunk-stream into the head-node store
         # (encode_value / _fetch_segment_chunked)
         self.inline_only = False
+        # ---- out-of-band object plane (object_agent.py): resolve an
+        # object's location once through the hub directory, then move
+        # the bytes peer<->peer over the owner node's object-agent
+        # endpoint. Any direct-path error falls back to the hub relay.
+        self._direct_enabled = os.environ.get(
+            "RAY_TPU_OBJECT_DIRECT", "1"
+        ).lower() not in ("0", "false", "no")
+        # oid -> RESOLVE_OBJECT reply; invalidated by the __obj_freed__
+        # and __node_down__ pubsub channels, FIFO-bounded like
+        # _known_ready (insertion-ordered dict)
+        self._resolve_cache: Dict[bytes, dict] = {}
+        # endpoint -> [idle connection, ...]; a transfer checks a
+        # connection out for its whole duration (the agent serves one
+        # verb at a time per connection)
+        self._agent_pool: Dict[str, List[Any]] = {}
+        self._agent_pool_lock = threading.Lock()
+        # head node's object-agent endpoint for direct puts:
+        # None = not resolved yet, "" = unavailable (stay on the relay)
+        self._head_agent_endpoint: Optional[str] = None
+        # ---- readiness push: wait() subscribes once per unknown ref
+        # set; the hub pushes ready ids as tasks finish (P.READY_PUSH),
+        # the reader thread records them in _known_ready and pokes this
+        # event to re-scan any parked wait()
+        self._ready_push = os.environ.get(
+            "RAY_TPU_READY_PUSH", "1"
+        ).lower() not in ("0", "false", "no")
+        self._ready_evt = threading.Event()
+        # ids this client has already registered for push (cross-call
+        # memo): a pop-loop's dry calls must not re-send the same 1k-id
+        # subscription per push batch. Entries leave when the push
+        # arrives (_on_ready_push) or on free; a stalled wait clears
+        # its ids to force a re-sync (_wait_push retry period).
+        self._ready_subscribed: set = set()
         # multi-tenant scheduling identity (set by register_job): every
         # submit/PG-create from this client is stamped with it so the
         # hub's fairsched engine can order/quota/preempt per tenant
@@ -105,6 +146,7 @@ class CoreClient:
             P.REPLY: self._on_reply,
             P.PUBSUB_MSG: self._on_pubsub_msg,
             P.CANCEL_TASK: self._on_cancel_task,
+            P.READY_PUSH: self._on_ready_push,
         }
         self.send(P.HELLO, {"role": role, "worker_id": worker_id,
                             "pid": os.getpid(), "node_id": self.node_id})
@@ -113,11 +155,33 @@ class CoreClient:
         # indefinitely; the follow-up get would raise ObjectLostError)
         self.subscriptions["__obj_freed__"] = self._on_objs_freed
         self.send(P.SUBSCRIBE, {"channel": "__obj_freed__"})
+        # node loss invalidates cached object locations (stale-endpoint
+        # reads must fail over to re-resolve / hub relay, never hang on
+        # a dead host)
+        self.subscriptions["__node_down__"] = self._on_node_down
+        self.send(P.SUBSCRIBE, {"channel": "__node_down__"})
         self._reader = threading.Thread(target=self._read_loop, daemon=True, name="core-client-reader")
         self._reader.start()
 
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True, name="core-client-flusher")
         self._flusher.start()
+
+    def start_prewarm(self, store_cap: float = 0.0) -> None:
+        """Kick the background warm-pool prewarm (driver only; see
+        object_store.prewarm). Disabled when the node runs a bounded
+        object store — pool files live outside the cap's accounting,
+        and a capped deployment is memory-constrained by definition."""
+        from .config import RAY_TPU_CONFIG
+
+        nbytes = int(os.environ.get(
+            "RAY_TPU_SEGMENT_PREWARM_BYTES",
+            RAY_TPU_CONFIG.segment_prewarm_bytes,
+        ))
+        if nbytes > 0 and store_cap <= 0 and not self.inline_only:
+            threading.Thread(
+                target=self.store.prewarm, args=(nbytes,),
+                daemon=True, name="segment-prewarm",
+            ).start()
 
     # ------------------------------------------------------------------ wire
     #
@@ -162,13 +226,17 @@ class CoreClient:
                 self.conn.send_bytes(dumps_frame(("batch", buf)))
 
     def _flush_loop(self) -> None:
-        # Catches stray buffered messages ~0.5ms after the burst ends.
-        # The 50ms wait timeout doubles as the drain cadence for the
-        # lock-free release buffer (__del__ can't signal the event:
-        # Event.set takes a lock, and __del__ may preempt a thread that
-        # already holds it).
+        # Catches stray buffered messages ~0.5ms after the burst ends
+        # (send latency is event-driven: send_async sets _buf_evt on the
+        # first buffered message). The wait timeout doubles as the drain
+        # cadence for the lock-free release buffer (__del__ can't signal
+        # the event: Event.set takes a lock, and __del__ may preempt a
+        # thread that already holds it) — 50ms while releases are
+        # flowing, backed off to 250ms when idle so a big cluster of
+        # idle workers doesn't burn the core with timer wakeups.
         while not self._closed:
-            self._buf_evt.wait(timeout=0.05)
+            timeout = 0.05 if self._release_buf else 0.25
+            self._buf_evt.wait(timeout=timeout)
             self._buf_evt.clear()
             time.sleep(0.0005)
             try:
@@ -208,6 +276,7 @@ class CoreClient:
 
     def _fail_pending(self, why: str) -> None:
         self._closed = True
+        self._ready_evt.set()  # unpark push-waiting wait() loops
         with self._pending_lock:
             pending, self._pending = self._pending, {}
         for fut in pending.values():
@@ -217,10 +286,49 @@ class CoreClient:
 
     def _on_objs_freed(self, oids) -> None:
         """Runs on the reader thread (pubsub callback): drop freed ids
-        from the readiness cache."""
+        from the readiness and location caches."""
         with self._obj_cache_lock:
             for oid in oids:
                 self._known_ready.pop(oid, None)
+                self._resolve_cache.pop(oid, None)
+                self._ready_subscribed.discard(oid)
+
+    def _on_node_down(self, data) -> None:
+        """Runs on the reader thread: a node died — every cached
+        location pointing at it is stale, and pooled connections to its
+        object agent are dead."""
+        node_id = (data or {}).get("node_id")
+        if not node_id:
+            return
+        endpoints = set()
+        with self._obj_cache_lock:
+            for oid in [
+                o for o, info in self._resolve_cache.items()
+                if info.get("node_id") == node_id
+            ]:
+                info = self._resolve_cache.pop(oid)
+                if info.get("endpoint"):
+                    endpoints.add(info["endpoint"])
+        with self._agent_pool_lock:
+            for ep in endpoints:
+                for conn in self._agent_pool.pop(ep, []):
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+
+    def _on_ready_push(self, payload) -> None:
+        """Runs on the reader thread: the hub pushed a batch of
+        newly-ready object ids (readiness subscription, _wait_push)."""
+        with self._obj_cache_lock:
+            known = self._known_ready
+            subscribed = self._ready_subscribed
+            for b in payload.get("ready", ()):
+                known[b] = True
+                subscribed.discard(b)
+            while len(known) > 65536:
+                known.pop(next(iter(known)), None)
+        self._ready_evt.set()
 
     def _dispatch_inbound(self, msg_type, payload):
         # table dispatch, mirroring the hub's {msg_type: bound_method}
@@ -297,6 +405,8 @@ class CoreClient:
         P.GET_ACTOR, P.GET_FUNCTION, P.LIST_STATE, P.CLUSTER_RESOURCES,
         P.PG_READY, P.STREAM_NEXT, P.STREAM_CREDIT, P.FETCH_OBJECT,
         P.REGISTER_JOB,  # idempotent upsert keyed by job_id
+        P.RESOLVE_OBJECT,   # pure read of the location directory
+        P.SUBSCRIBE_READY,  # idempotent watcher registration
     }
     _RETRY_PERIOD_S = 2.0
 
@@ -369,25 +479,77 @@ class CoreClient:
             return P.VAL_INLINE, blob, nbytes
         name = oid.hex()
         if self.inline_only:
-            # chunk-stream the segment bytes to the hub; the last chunk
-            # makes the object ready cluster-side (the duplicate PUT the
-            # caller sends afterwards is a no-op: _object_ready ignores
-            # already-ready objects)
+            # Stream the segment into the HEAD node's store. Preferred
+            # path: out-of-band direct put to the head's object agent —
+            # the bytes never enter the hub reactor; the caller's PUT
+            # message then flips the object ready. Fallback: PUT_CHUNK
+            # relay through the hub (the last chunk makes the object
+            # ready cluster-side; the duplicate PUT the caller sends
+            # afterwards is a no-op: _object_ready ignores already-ready
+            # objects).
             from .object_store import iter_segment_chunks
 
-            total, chunks = iter_segment_chunks(
-                header, [b.raw() for b in buffers]
-            )
+            raws = [b.raw() for b in buffers]
+            fallback = None
+            if self._direct_enabled:
+                try:
+                    self._direct_put(name, *iter_segment_chunks(header, raws))
+                    return P.VAL_SHM, name, nbytes
+                except Exception as err:
+                    fallback = f"{type(err).__name__}: {err}"
+            total, chunks = iter_segment_chunks(header, raws)
             sent = 0
             for piece in chunks:
-                sent += len(piece)
-                self.send(P.PUT_CHUNK, {
+                msg = {
                     "object_id": oid.binary(), "name": name,
-                    "data": piece, "last": sent >= total,
-                })
+                    "offset": sent, "data": piece,
+                }
+                if fallback is not None and sent == 0:
+                    msg["fallback"] = fallback
+                sent += len(piece)
+                msg["last"] = sent >= total
+                self.send(P.PUT_CHUNK, msg)
             return P.VAL_SHM, name, nbytes
         self.store.put_raw(name, header, [b.raw() for b in buffers])
         return P.VAL_SHM, name, nbytes
+
+    def _head_endpoint(self) -> str:
+        """The head node's object-agent endpoint for direct puts
+        (cached; "" = head serves no agent, stay on the relay)."""
+        ep = self._head_agent_endpoint
+        if ep is None:
+            reply = self.request(P.RESOLVE_OBJECT, {"node_id": "node0"})
+            ep = self._head_agent_endpoint = reply.get("endpoint") or ""
+        return ep
+
+    def _direct_put(self, name: str, total: int, chunks) -> None:
+        """Stream a large client-mode put out-of-band to the head's
+        object agent. Raises on ANY irregularity; the caller falls back
+        to the PUT_CHUNK hub relay."""
+        endpoint = self._head_endpoint()
+        if not endpoint:
+            raise OSError("head node serves no object agent")
+        conn = self._agent_checkout(endpoint)
+        ok = False
+        try:
+            sent = 0
+            for piece in chunks:
+                sent += len(piece)
+                conn.send_bytes(dumps_frame(("obj_put", {
+                    "name": name, "data": piece, "last": sent >= total,
+                })))
+            msg_type, p = loads_frame(conn.recv_bytes())
+            if msg_type != "obj_put_ok":
+                raise OSError(p.get("error") or f"unexpected frame {msg_type}")
+            ok = True
+        finally:
+            if ok:
+                self._agent_checkin(endpoint, conn)
+            else:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
 
     def decode_value(self, oid_bytes: bytes, kind: str, payload: Any) -> Any:
         if kind == P.VAL_INLINE:
@@ -399,35 +561,165 @@ class CoreClient:
             try:
                 return self.store.get(payload)
             except FileNotFoundError:
-                # segment lives on another node: pull it through the hub
-                # (reference: object manager pull, ownership directory).
-                # Shm-less clients stream it in chunks so a multi-GB get
-                # never materializes twice in hub memory.
-                if self.inline_only:
-                    self._fetch_segment_chunked(oid_bytes, payload)
-                else:
-                    reply = self.request(
-                        P.FETCH_OBJECT, {"object_id": oid_bytes}
-                    )
-                    if reply.get("data") is None:
-                        with self._obj_cache_lock:
-                            self._known_ready.pop(oid_bytes, None)
-                        raise exceptions.ObjectLostError(
-                            f"object {oid_bytes.hex()} unavailable: "
-                            f"{reply.get('error')}"
-                        ) from None
-                    self.store.write_segment(payload, reply["data"])
+                # segment lives on another node: resolve its location
+                # once and pull it DIRECTLY from the owner's object
+                # agent (out-of-band object plane), falling back to the
+                # hub-relay chunked fetch on any transfer error
+                # (reference: object manager pull + ownership
+                # directory). Every path streams in chunks so a
+                # multi-GB get never materializes twice in one process.
+                self._fetch_segment(oid_bytes, payload)
                 return self.store.get(payload)
         if kind == P.VAL_ERROR:
             err = loads_inline(payload)
             raise err
         raise ValueError(f"unknown value kind {kind}")
 
-    def _fetch_segment_chunked(self, oid_bytes: bytes, name: str) -> None:
-        """Pull a remote segment into the local scratch store in
-        FETCH_CHUNK slices (reference: dataservicer.py chunked
+    # ------------------------------------------- out-of-band object plane
+    def _resolve_object(self, oid_bytes: bytes) -> Optional[dict]:
+        """Query (and cache) the hub's ownership/location directory.
+        Returns None when the object has no resolvable shm location."""
+        with self._obj_cache_lock:
+            info = self._resolve_cache.get(oid_bytes)
+        if info is not None:
+            return info
+        reply = self.request(P.RESOLVE_OBJECT, {"object_id": oid_bytes})
+        if reply.get("error") or not reply.get("name"):
+            return None
+        if reply.get("spilled"):
+            # relay territory (restore-under-accounting); uncached so a
+            # later fetch re-resolves the post-restore location
+            return None
+        info = {
+            "name": reply["name"],
+            "node_id": reply.get("node_id"),
+            "endpoint": reply.get("endpoint"),
+            "hostname": reply.get("hostname"),
+            "path": reply.get("path"),
+        }
+        with self._obj_cache_lock:
+            cache = self._resolve_cache
+            cache[oid_bytes] = info
+            while len(cache) > 4096:  # FIFO bound; eviction = re-resolve
+                cache.pop(next(iter(cache)))
+        return info
+
+    def _invalidate_resolve(self, oid_bytes: bytes, endpoint: Optional[str]) -> None:
+        with self._obj_cache_lock:
+            self._resolve_cache.pop(oid_bytes, None)
+        if endpoint:
+            with self._agent_pool_lock:
+                for conn in self._agent_pool.pop(endpoint, []):
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+
+    def _agent_checkout(self, endpoint: str):
+        with self._agent_pool_lock:
+            pool = self._agent_pool.get(endpoint)
+            if pool:
+                return pool.pop()
+        return connect_hub(endpoint)
+
+    def _agent_checkin(self, endpoint: str, conn) -> None:
+        with self._agent_pool_lock:
+            pool = self._agent_pool.setdefault(endpoint, [])
+            if len(pool) < 4:
+                pool.append(conn)
+                return
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def _direct_pull(self, endpoint: str, name: str, dst_tmp: str) -> None:
+        """Stream one segment from a peer's object agent into dst_tmp.
+        Raises on ANY irregularity; the caller falls back to the relay."""
+        conn = self._agent_checkout(endpoint)
+        ok = False
+        try:
+            conn.send_bytes(dumps_frame(("obj_get", {"name": name})))
+            with open(dst_tmp, "wb") as f:
+                while True:
+                    msg_type, p = loads_frame(conn.recv_bytes())
+                    if msg_type != "obj_data":
+                        raise OSError(
+                            p.get("error") or f"unexpected frame {msg_type}"
+                        )
+                    f.write(p["data"])
+                    if p.get("last"):
+                        break
+            ok = True
+        finally:
+            if ok:
+                self._agent_checkin(endpoint, conn)
+            else:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def _fetch_segment(self, oid_bytes: bytes, name: str) -> None:
+        """Install a remote segment into the local store: same-host
+        file copy when the producer's objects dir is visible on this
+        machine, direct object-agent stream otherwise, hub relay as the
+        fallback of last resort (transfer-path matrix in the README)."""
+        fallback_reason = None
+        if self._direct_enabled:
+            info = self._resolve_object(oid_bytes)
+            if info is not None:
+                tmp = (
+                    self.store._path(name)
+                    + f".fetch.{os.getpid()}.{threading.get_ident()}"
+                )
+                try:
+                    src = None
+                    if info.get("hostname") == self.hostname:
+                        # producer's store is on THIS machine: its
+                        # segment file is directly readable
+                        cand = info.get("path")
+                        if cand and cand != self.store._path(name) \
+                                and os.path.isfile(cand):
+                            src = cand
+                    if src is not None:
+                        # same-host shm: the producer's segment is a
+                        # local file — copy at memcpy speed, no sockets
+                        import shutil
+
+                        shutil.copyfile(src, tmp)
+                    elif info.get("endpoint"):
+                        self._direct_pull(info["endpoint"], info["name"], tmp)
+                    else:
+                        raise OSError("no object-agent endpoint")
+                    os.replace(tmp, self.store._path(name))
+                    if not self.inline_only:
+                        # this node's shared store now holds a replica;
+                        # the directory can serve later consumers from it
+                        # (a client-mode scratch dir is private — not a
+                        # replica anyone else could read)
+                        self.send_async(P.REPLICA_ADDED, {
+                            "object_id": oid_bytes, "node_id": self.node_id,
+                        })
+                    return
+                except Exception as err:  # fall back to the hub relay
+                    fallback_reason = f"{type(err).__name__}: {err}"
+                    self._invalidate_resolve(oid_bytes, info.get("endpoint"))
+                finally:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+        self._fetch_segment_chunked(oid_bytes, name, fallback=fallback_reason)
+
+    def _fetch_segment_chunked(self, oid_bytes: bytes, name: str,
+                               fallback: Optional[str] = None) -> None:
+        """Pull a remote segment into the local store through the hub
+        relay in FETCH_CHUNK slices (reference: dataservicer.py chunked
         GetObject). Idempotent offset reads, so the retry-safe request
-        path applies per chunk."""
+        path applies per chunk. `fallback` carries the direct-transfer
+        failure reason so the hub records the object_transfer_fallback
+        event and bumps ray_tpu_object_fallbacks_total."""
         # pid AND thread id: two threads get()ing the same not-yet-local
         # ref fetch independently; same bytes, last replace wins
         tmp = (
@@ -438,11 +730,14 @@ class CoreClient:
         try:
             with open(tmp, "wb") as f:
                 while total is None or off < total:
-                    reply = self.request(P.FETCH_OBJECT, {
+                    req = {
                         "object_id": oid_bytes,
                         "offset": off,
                         "length": self.FETCH_CHUNK,
-                    })
+                    }
+                    if fallback is not None and off == 0:
+                        req["fallback"] = fallback
+                    reply = self.request(P.FETCH_OBJECT, req)
                     data = reply.get("data")
                     if data is None or (not data and off < (total or 1)):
                         with self._obj_cache_lock:
@@ -506,27 +801,62 @@ class CoreClient:
         fetch_local: bool = True,
     ) -> Tuple[List[bytes], List[bytes]]:
         ids = [o.binary() for o in object_ids]
-        # Local fast path: readiness already known from a prior wait
-        # reply (also_ready) or a cached value — a wait() pop-loop over
-        # 1k refs then costs a handful of round trips instead of one per
-        # ref. Readiness is monotonic except for cross-client frees and
-        # node-loss reconstruction; in those rare races the follow-up
-        # get() blocks through reconstruction or raises ObjectLostError
-        # — the same TOCTOU a hub round-trip reply has (decode_value
-        # un-caches on loss, below).
+        ready_pos, not_ready_pos = self.wait_pos(ids, num_returns, timeout)
+        return [ids[i] for i in ready_pos], [ids[i] for i in not_ready_pos]
+
+    def _scan_ready(self, ids: List[bytes], num_returns: int) -> List[int]:
+        """Positions of locally-known-ready ids, stopping at
+        num_returns hits. Readiness is monotonic except for
+        cross-client frees and node-loss reconstruction; in those rare
+        races the follow-up get() blocks through reconstruction or
+        raises ObjectLostError — the same TOCTOU a hub round-trip reply
+        has (decode_value un-caches on loss)."""
         known = self._known_ready
+        cache = self._obj_cache
+        ready: List[int] = []
         with self._obj_cache_lock:
-            ready_local = [
-                b for b in ids if b in known or b in self._obj_cache
-            ]
-        if len(ready_local) >= num_returns:
-            ready = ready_local[:num_returns]
-            rset = set(ready)
-            return ready, [b for b in ids if b not in rset]
+            for i, b in enumerate(ids):
+                if b in known or b in cache:
+                    ready.append(i)
+                    if len(ready) >= num_returns:
+                        break
+        return ready
+
+    def wait_pos(
+        self,
+        ids: List[bytes],
+        num_returns: int,
+        timeout: Optional[float],
+    ) -> Tuple[List[int], List[int]]:
+        """wait() by POSITION in `ids` — the pop-loop shape (1k refs,
+        num_returns=1, re-called per pop) stays O(n) per call instead
+        of O(n) dict builds on every layer above.
+
+        Fast path: the local readiness cache, fed by READY_PUSH.
+        Slow path: ONE readiness subscription for the unknown ids (the
+        hub replies with the already-ready subset and pushes the rest
+        as producing tasks finish), then park on _ready_evt. The
+        periodic re-subscribe below makes lost pushes (chaos drops,
+        hub restart races) cost one retry period, not a hang."""
+        num_returns = min(num_returns, len(ids))
+        if num_returns <= 0:
+            return [], list(range(len(ids)))
+        ready = self._scan_ready(ids, num_returns)
+        if len(ready) < num_returns:
+            if not self._ready_push:
+                ready = self._wait_request(ids, num_returns, timeout)
+            else:
+                ready = self._wait_push(ids, num_returns, timeout)
+        rset = set(ready)
+        return ready, [i for i in range(len(ids)) if i not in rset]
+
+    def _wait_request(self, ids, num_returns, timeout) -> List[int]:
+        """Classic parked-WAIT request path (RAY_TPU_READY_PUSH=0)."""
         reply = self.request(
             P.WAIT,
             {"object_ids": ids, "num_returns": num_returns, "timeout": timeout},
         )
+        known = self._known_ready
         with self._obj_cache_lock:
             for b in reply["ready"]:
                 known[b] = True
@@ -534,13 +864,77 @@ class CoreClient:
                 known[b] = True
             while len(known) > 65536:  # FIFO cap; eviction costs a re-ask
                 known.pop(next(iter(known)), None)
-        return reply["ready"], reply["not_ready"]
+        rset = set(reply["ready"])
+        return [i for i, b in enumerate(ids) if b in rset][:num_returns]
+
+    def _wait_push(self, ids, num_returns, timeout) -> List[int]:
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        while True:
+            self._ready_evt.clear()
+            ready = self._scan_ready(ids, num_returns)
+            if len(ready) >= num_returns:
+                return ready
+            if self._closed:
+                raise ConnectionError("hub connection lost")
+            # register any id not already covered by a live
+            # subscription (cross-call memo: a pop-loop subscribes each
+            # id ONCE total, not once per dry call); the reply carries
+            # the subset that is already ready hub-side
+            known = self._known_ready
+            subscribed = self._ready_subscribed
+            with self._obj_cache_lock:
+                need = [
+                    b for b in ids
+                    if b not in known and b not in self._obj_cache
+                    and b not in subscribed
+                ]
+            if need:
+                reply = self.request(
+                    P.SUBSCRIBE_READY, {"object_ids": need}
+                )
+                with self._obj_cache_lock:
+                    rdy = reply.get("ready", ())
+                    for b in rdy:
+                        known[b] = True
+                    rdy = set(rdy)
+                    subscribed.update(b for b in need if b not in rdy)
+                    while len(known) > 65536:
+                        known.pop(next(iter(known)), None)
+                    # hard bound: ids whose producers never finish would
+                    # pin the memo; past the cap, drop it wholesale (the
+                    # cost is one redundant re-subscribe per waiter)
+                    if len(subscribed) > 131072:
+                        subscribed.clear()
+                continue  # re-scan with the reply folded in
+            remaining = self._RETRY_PERIOD_S
+            if deadline is not None:
+                remaining = min(remaining, deadline - time.monotonic())
+                if remaining <= 0:
+                    return ready
+            if not self._ready_evt.wait(remaining):
+                # a full retry period with no push: drop these ids from
+                # the memo so the next pass re-subscribes — the reply
+                # re-syncs readiness even if pushes were lost (chaos)
+                with self._obj_cache_lock:
+                    self._ready_subscribed.difference_update(ids)
+            elif len(ids) >= 256:
+                # push debounce for BIG waits: completions stream one
+                # push at a time, and on a busy single-core host every
+                # wake of this thread steals the GIL from the hub
+                # thread mid-dispatch (they share this process for
+                # local drivers). One short sleep batches the next few
+                # pushes into a single wake/scan instead of one wake
+                # per completed task; small waits stay latency-exact.
+                time.sleep(0.002)
 
     def free(self, object_ids: Sequence[ObjectID]) -> None:
         with self._obj_cache_lock:
             for o in object_ids:
                 self._obj_cache.pop(o.binary(), None)
                 self._known_ready.pop(o.binary(), None)
+                self._resolve_cache.pop(o.binary(), None)
         for o in object_ids:
             # drop any locally-fetched copy of a remote segment too
             self.store.free(o.hex())
@@ -781,6 +1175,15 @@ class CoreClient:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            self._ready_evt.set()  # unpark any push-waiting wait()
+            with self._agent_pool_lock:
+                pools, self._agent_pool = self._agent_pool, {}
+            for conns in pools.values():
+                for c in conns:
+                    try:
+                        c.close()
+                    except Exception:
+                        pass
             try:
                 self.conn.close()
             except Exception:

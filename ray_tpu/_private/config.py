@@ -15,6 +15,24 @@ _DEFAULTS: Dict[str, Any] = {
     # object plane
     "inline_object_threshold": 100 * 1024,   # plasma-vs-inline cutoff
     "object_store_memory": 0.0,              # 0 = unlimited (no spill)
+    # out-of-band object plane (object_agent.py): per-node data-plane
+    # endpoints + the hub's ownership/location directory. object_agent
+    # turns the serving side on; object_direct turns the consuming side
+    # (resolve-then-pull / direct put) on — with either off, transfers
+    # ride the hub-relay path exactly as before.
+    "object_agent": True,
+    "object_direct": True,
+    # readiness push: wait() over not-ready refs subscribes once and the
+    # hub pushes ready sets; off = the classic parked-WAIT request path
+    "ready_push": True,
+    # driver-side warm segment pool: pre-create + pre-fault this many
+    # bytes of pooled tmpfs segments in the background at init, so the
+    # FIRST large put already memcpys into faulted pages (the plasma
+    # arena trick). Split into two segments (each serves one put up to
+    # half the budget; the default's 264 MiB halves cover 256 MiB-class
+    # objects with slack, so carving one truncates away only a few MiB
+    # of warm tail pages). 0 = off.
+    "segment_prewarm_bytes": 2 * 264 * 1024 * 1024,
     # scheduling / workers
     "worker_reap_period_s": 1.0,
     "max_pending_spawns_per_node": 32,

@@ -81,6 +81,14 @@ class ObjEntry:
     size: int = 0
     node_id: str = "node0"  # producer node (VAL_SHM segments live there)
     spilled: bool = False  # primary copy moved to disk (LRU eviction)
+    # ownership/location directory (reference: the ownership table +
+    # object directory, src/ray/core_worker/reference_count.h +
+    # object_manager/ownership_object_directory.h): nodes holding a
+    # byte-identical copy installed by a direct fetch. The owner
+    # (node_id) is implicit; replicas let RESOLVE_OBJECT fail over when
+    # the owner dies. None until the first replica (the common case
+    # allocates nothing).
+    replicas: Optional[Set[str]] = None
     # (conn, req_id) waiters registered by pending GETs
     task_waiters: List[bytes] = field(default_factory=list)  # task_ids blocked on this obj
     # dependency pins: in-flight tasks (and live actors, for creation
@@ -124,6 +132,10 @@ class NodeEntry:
     # external_storage.py spilling): bytes of live segments vs the cap
     store_cap: float = 0.0  # 0 = unlimited
     store_used: float = 0.0
+    # out-of-band object plane: this node's object_agent endpoint
+    # ("tcp://host:port" or an AF_UNIX path; "" = agent disabled —
+    # transfers to/from this node ride the hub relay)
+    object_endpoint: str = ""
 
 
 @dataclass
@@ -429,6 +441,12 @@ class Hub:
         self.get_reqs: List[GetReq] = []
         self.obj_get_waiters: Dict[bytes, List[GetReq]] = {}
         self.obj_wait_waiters: Dict[bytes, List[WaitReq]] = {}
+        # readiness-push subscriptions (SUBSCRIBE_READY/READY_PUSH):
+        # oid -> conns to push to when it becomes ready, plus the
+        # reverse index for O(subscribed) disconnect pruning. Entries
+        # leave on push, free, and disconnect.
+        self._ready_watchers: Dict[bytes, List[Any]] = {}
+        self._ready_watch_conns: Dict[int, Set[bytes]] = {}
         # retransmit dedup: clients resend slow GET/WAIT requests every
         # ~2s (lost-reply tolerance); while the original is still parked
         # here, the resend must NOT register a second full waiter set.
@@ -513,6 +531,30 @@ class Hub:
         self._msg_metrics: Dict[str, tuple] = {}
         self._node_gauges: Dict[str, tuple] = {}
         self._seed_builtin_metrics()
+        # out-of-band object plane: the head node's data-plane endpoint
+        # (object_agent.py). Bulk segment bytes move through it —
+        # threads of their own — so a multi-GB transfer never parks the
+        # reactor behind a memcpy. Remote hosts run one inside their
+        # node agent and register its endpoint.
+        self.object_agent = None
+        if self.config.object_agent:
+            from .object_agent import ObjectAgent
+
+            try:
+                if tcp:
+                    self.object_agent = ObjectAgent(
+                        os.path.join(session_dir, "objects"),
+                        spill_dir=self.spill_dir, host=host,
+                    )
+                else:
+                    self.object_agent = ObjectAgent(
+                        os.path.join(session_dir, "objects"),
+                        spill_dir=self.spill_dir,
+                        unix_path=os.path.join(session_dir, "object_agent.sock"),
+                    )
+                head.object_endpoint = self.object_agent.endpoint
+            except OSError:
+                log_exc("head object agent failed to start (relay only)")
         self._shutdown_evt = threading.Event()
         self.thread = threading.Thread(target=self._run, daemon=True, name="ray-tpu-hub")
 
@@ -591,6 +633,8 @@ class Hub:
         for conn in list(self.agent_conns):
             self._send(conn, P.KILL, {})
         self._flush_outbox()
+        if self.object_agent is not None:
+            self.object_agent.close()
         try:
             self.listener.close()
         except Exception:
@@ -686,6 +730,8 @@ class Hub:
                 )),
             )
             self._bm_store_gauge(head)
+            if self.object_agent is not None:
+                self._object_direct_gauges("node0", self.object_agent.stats())
         self._add_timer(self.config.node_heartbeat_period_s, self._head_heartbeat)
 
     def _node_stat_gauges(self, node_id: str, **stats: float) -> None:
@@ -704,6 +750,8 @@ class Hub:
             cpu_load_1m=float(p.get("cpu_load_1m", 0.0)),
             n_workers=float(p.get("n_workers", 0.0)),
         )
+        if p.get("object_agent"):
+            self._object_direct_gauges(node.node_id, p["object_agent"])
         self._bm_store_gauge(node)
 
     def _add_timer(self, delay: float, cb):
@@ -802,6 +850,42 @@ class Hub:
         self._bm_pending_quota = bm(
             "ray_tpu_sched_pending_quota", "gauge",
             "tasks parked at admission by their tenant's quota")
+        self._bm_obj_fallbacks = bm(
+            "ray_tpu_object_fallbacks_total", "counter",
+            "direct object transfers that fell back to the hub relay")
+        # (oid, kind, reason) seen recently — a retransmitted first
+        # chunk must not double-count its transfer's fallback
+        self._fallback_seen: Dict[tuple, bool] = {}
+
+    def _record_fallback(self, oid: bytes, reason: str, kind: str) -> None:
+        """One direct-path transfer failed over to the hub relay:
+        flight-recorder event + ray_tpu_object_fallbacks_total."""
+        key = (oid, kind, reason)
+        if key in self._fallback_seen:
+            return  # retransmit of the same flagged chunk
+        self._fallback_seen[key] = True
+        while len(self._fallback_seen) > 1024:
+            self._fallback_seen.pop(next(iter(self._fallback_seen)))
+        self._bm_obj_fallbacks["value"] += 1
+        self._record_event(
+            "object_transfer_fallback",
+            object_id=oid.hex() if isinstance(oid, bytes) else str(oid),
+            op=kind, reason=str(reason)[:200],
+        )
+
+    def _object_direct_gauges(self, node_id: str, stats: dict) -> None:
+        """Per-node out-of-band transfer counters (served + received
+        bytes move through object agents, never this reactor — the
+        numbers arrive on heartbeats)."""
+        tags = (("node_id", node_id),)
+        self._bm("ray_tpu_object_direct_bytes", "counter",
+                 "bytes moved over the out-of-band object plane",
+                 tags)["value"] = float(
+            stats.get("bytes_served", 0) + stats.get("bytes_received", 0)
+        )
+        self._bm("ray_tpu_object_direct_transfers_total", "counter",
+                 "completed out-of-band object transfers",
+                 tags)["value"] = float(stats.get("transfers", 0))
 
     def _bm_store_gauge(self, node: NodeEntry) -> None:
         g = self._node_gauges.get(node.node_id)
@@ -987,6 +1071,7 @@ class Hub:
             max_workers=p.get("max_workers") or 4,
             agent_conn=conn,
             store_cap=float(p.get("store_cap") or 0),
+            object_endpoint=p.get("object_endpoint") or "",
         )
         # dead nodes stay as tombstones for introspection/lineage
         self.nodes[node.node_id] = node  # graftlint: disable=GL009
@@ -1073,6 +1158,9 @@ class Hub:
             req.remaining.discard(oid)
             if not req.remaining:
                 self._fulfill_get(req)
+        # readiness push: one P.READY_PUSH per subscribed conn (batched
+        # into that peer's next outbox flush alongside everything else)
+        self._push_ready(oid)
         # fulfill WAIT waiters (registration is per-occurrence, so a req
         # appearing k times in the list gets k increments — consistent
         # with duplicate ids in the original request)
@@ -1342,6 +1430,7 @@ class Hub:
         freed_shm = []
         for oid in object_ids:
             e = self.objects.pop(oid, None)
+            self._drop_ready_watch(oid)
             if e and e.kind == P.VAL_SHM:
                 freed_shm.append(oid)
                 self._drop_segment_accounting(oid, e)
@@ -1367,12 +1456,120 @@ class Hub:
         if freed_shm and self.subscribers.get("__obj_freed__"):
             self._publish("__obj_freed__", freed_shm)
 
+    # ----- out-of-band object plane: ownership/location directory
+    def _on_resolve_object(self, conn, p):
+        """Where does an object live? Returns the owner node's (or, if
+        the owner died, a replica's) segment name, object-agent
+        endpoint, and local file path so the consumer can move the
+        bytes WITHOUT the hub (object_agent.py). Clients cache the
+        reply; __obj_freed__ / __node_down__ pubsub invalidate it.
+        A {node_id} query (no object_id) resolves just that node's
+        endpoint — used by client-mode direct puts to find the head."""
+        oid = p.get("object_id")
+        if oid is None:
+            node = self.nodes.get(p.get("node_id", ""))
+            self._reply(conn, p["req_id"],
+                        endpoint=node.object_endpoint if node else "")
+            return
+        e = self.objects.get(oid)
+        if e is None or not e.ready or e.kind != P.VAL_SHM:
+            self._reply(conn, p["req_id"], error="no such segment")
+            return
+        node = self.nodes.get(e.node_id)
+        if node is None or not node.alive:
+            node = None
+            for nid in sorted(e.replicas or ()):
+                cand = self.nodes.get(nid)
+                if cand is not None and cand.alive:
+                    node = cand
+                    break
+            if node is None:
+                # owner and every replica are gone: the relay path owns
+                # reconstruction (_on_fetch_object lineage rerun)
+                self._reply(conn, p["req_id"], error="object location lost")
+                return
+        payload = {
+            "name": e.payload,
+            "node_id": node.node_id,
+            "endpoint": node.object_endpoint,
+            "hostname": node.hostname,
+            "path": os.path.join(node.session_dir, "objects", e.payload),
+            # spilled objects stay on the relay: the hub's fetch path
+            # owns the restore-under-accounting step (and a same-node
+            # consumer must not quietly duplicate a spilled segment
+            # outside the store cap's books)
+            "spilled": e.spilled,
+        }
+        self._reply(conn, p["req_id"], **payload)
+
+    def _on_replica_added(self, conn, p):
+        """A direct fetch installed a copy on the sender's node: record
+        it so resolution can fail over if the owner dies. Replica sets
+        die with their ObjEntry (free/GC) — no separate pruning."""
+        e = self.objects.get(p.get("object_id"))
+        node_id = p.get("node_id")
+        if e is None or not e.ready or e.kind != P.VAL_SHM or not node_id:
+            return
+        if node_id != e.node_id:
+            if e.replicas is None:
+                e.replicas = set()
+            e.replicas.add(node_id)
+
+    # ----- readiness push (SUBSCRIBE_READY -> READY_PUSH)
+    def _on_subscribe_ready(self, conn, p):
+        """Register the connection for a readiness push on each not-yet
+        -ready id; reply with the subset that is already ready. The
+        push fires from _object_ready, so a wait() pop-loop costs one
+        subscription instead of a round trip per poll."""
+        ready = []
+        watched = self._ready_watch_conns.setdefault(id(conn), set())
+        for oid in p["object_ids"]:
+            e = self.objects.get(oid)
+            if e is not None and e.ready:
+                ready.append(oid)
+                continue
+            if e is None:
+                self.objects[oid] = ObjEntry()
+            watchers = self._ready_watchers.setdefault(oid, [])
+            if conn not in watchers:
+                watchers.append(conn)
+                watched.add(oid)
+        if not watched:
+            self._ready_watch_conns.pop(id(conn), None)
+        self._reply(conn, p["req_id"], ready=ready)
+
+    def _push_ready(self, oid: bytes) -> None:
+        watchers = self._ready_watchers.pop(oid, None)
+        if not watchers:
+            return
+        for conn in watchers:
+            self._send(conn, P.READY_PUSH, {"ready": [oid]})
+            watched = self._ready_watch_conns.get(id(conn))
+            if watched is not None:
+                watched.discard(oid)
+                if not watched:
+                    self._ready_watch_conns.pop(id(conn), None)
+
+    def _drop_ready_watch(self, oid: bytes) -> None:
+        """Forget watchers of a freed id (no push: the object will
+        never become ready; waiters re-sync on their retry period)."""
+        for conn in self._ready_watchers.pop(oid, ()):
+            watched = self._ready_watch_conns.get(id(conn))
+            if watched is not None:
+                watched.discard(oid)
+                if not watched:
+                    self._ready_watch_conns.pop(id(conn), None)
+
     def _on_fetch_object(self, conn, p):
         """Cross-node shm fetch: the consumer's local store misses, so the
         bytes are pulled from the producer node through the control plane
         (the reference's object manager push/pull, simplified: metadata
         and transfer share the hub connection — fine for control-plane
         sizes; TPU bulk tensors ride ICI collectives, not the store)."""
+        if p.get("fallback"):
+            # first relay chunk of a failed direct transfer: record it
+            # (once per transfer — only offset 0 carries the flag)
+            self._record_fallback(p["object_id"], p["fallback"], "fetch")
         e = self.objects.get(p["object_id"])
         if e is None or not e.ready or e.kind != P.VAL_SHM:
             self._reply(conn, p["req_id"], data=None, error="no such segment")
@@ -1475,6 +1672,15 @@ class Hub:
     # ----- chunked client puts (shm-less client -> head-node store;
     # reference: util/client/server/dataservicer.py PutObject chunking)
     def _on_put_chunk(self, conn, p):
+        e = self.objects.get(p["object_id"])
+        if e is not None and e.ready:
+            # replayed chunk after the stream completed (retransmit of
+            # a lost-reply tail): the first `last` already sealed the
+            # segment synchronously, so anything arriving now must not
+            # reopen the stream or clobber the installed file
+            return
+        if p.get("fallback"):
+            self._record_fallback(p["object_id"], p["fallback"], "put")
         name = p["name"]
         key = (id(conn), name)
         objdir = os.path.join(self.session_dir, "objects")
@@ -1486,6 +1692,13 @@ class Hub:
                 st = self._client_puts[key] = open(tmp, "wb")
             if isinstance(st, tuple):  # stream already failed
                 raise OSError(st[1])
+            # explicit offset makes replays idempotent: a retransmitted
+            # chunk seeks back and rewrites the same bytes instead of
+            # appending them again (and the final size below is
+            # tell() = offset+len of the true last chunk, so offset
+            # accounting can't double-advance either)
+            if p.get("offset") is not None:
+                st.seek(p["offset"])
             st.write(p["data"])
         except OSError as err:
             # poison the stream: later chunks are dropped and the LAST
@@ -2098,8 +2311,14 @@ class Hub:
                 # burst of actor creations drain the pool to zero (each
                 # replacement is fresh, so its claim replenished
                 # nothing).
+                # _node_worker_count already includes the WorkerEntry
+                # rows of in-flight ("starting") spawns, so adding
+                # node.spawning here double-counted them: a burst of k
+                # claims replenished only ~k/2 workers and the NEXT task
+                # burst paid the missing interpreter spawns in-band
+                # (observed as a 3x-slow first wait_1k round)
                 pooled = self._node_worker_count(node.node_id)
-                if pooled + node.spawning < node.max_workers:
+                if pooled < node.max_workers:
                     # replenish with the SAME runtime env the claimed
                     # worker served, or env-specific bursts still stall
                     self._spawn_worker(
@@ -2844,6 +3063,16 @@ class Hub:
         cid = id(conn)
         for key in [k for k in self._inflight_reqs if k[0] == cid]:
             del self._inflight_reqs[key]
+        # readiness subscriptions die with the connection
+        for oid in self._ready_watch_conns.pop(cid, ()):
+            watchers = self._ready_watchers.get(oid)
+            if watchers is not None:
+                try:
+                    watchers.remove(conn)
+                except ValueError:
+                    pass
+                if not watchers:
+                    del self._ready_watchers[oid]
         self.fairsched.drop_conn(cid)
         # prune per-tenant gauges for tenants the drop removed (the
         # charge/settle sites are gated on live tenants and would
@@ -2898,6 +3127,10 @@ class Hub:
             g[0]["value"] = 0.0  # store bytes
             g[1]["value"] = 0.0  # chips in use
         self._fail_fetches_for_node(node_id)
+        # invalidate client-side location caches: any resolve pointing
+        # at this node is stale and must re-resolve (replica or relay)
+        if self.subscribers.get("__node_down__"):
+            self._publish("__node_down__", {"node_id": node_id})
         self._dispatch()
 
     def _worker_died(self, worker: WorkerEntry):

@@ -54,6 +54,25 @@ class NodeAgent:
         )
         os.makedirs(os.path.join(self.session_dir, "objects"), exist_ok=True)
         self.children: Dict[str, subprocess.Popen] = {}
+        # out-of-band object plane: this host's data-plane endpoint.
+        # Peers resolve it through the hub directory and stream segment
+        # bytes here directly — the hub relay (OBJ_READ) stays as the
+        # fallback. TCP because cluster mode is TCP (remote peers must
+        # be able to reach it); bound to this host's address.
+        self.object_agent = None
+        from .config import RAY_TPU_CONFIG
+
+        if RAY_TPU_CONFIG.object_agent:
+            from .object_agent import ObjectAgent
+
+            try:
+                self.object_agent = ObjectAgent(
+                    os.path.join(self.session_dir, "objects"),
+                    spill_dir=self.spill_dir,
+                    host=os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1"),
+                )
+            except OSError:
+                pass  # relay-only node
         self.conn = connect_hub(self.hub_addr)
 
         resources = {"CPU": float(os.environ.get("RAY_TPU_NUM_CPUS", "1"))}
@@ -83,6 +102,9 @@ class NodeAgent:
                 ),
                 "store_cap": float(
                     os.environ.get("RAY_TPU_OBJECT_STORE_MEMORY", 0)
+                ),
+                "object_endpoint": (
+                    self.object_agent.endpoint if self.object_agent else ""
                 ),
             },
         )
@@ -137,6 +159,9 @@ class NodeAgent:
                 "rss_bytes": rss,
                 "cpu_load_1m": load,
                 "n_workers": len(self.children),
+                "object_agent": (
+                    self.object_agent.stats() if self.object_agent else None
+                ),
             },
         )
 
@@ -224,6 +249,8 @@ class NodeAgent:
                     pass
 
     def _shutdown(self) -> None:
+        if self.object_agent is not None:
+            self.object_agent.close()
         for proc in self.children.values():
             try:
                 proc.terminate()

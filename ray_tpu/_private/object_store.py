@@ -15,9 +15,14 @@ mirroring the reference's in-process CoreWorkerMemoryStore
 (src/ray/core_worker/store_provider/memory_store/memory_store.h:45).
 
 Wire layout of a segment:
-    [8B u64 header_len][header bytes]
+    [8B u64 total_layout_size][8B u64 header_len][header bytes]
     per out-of-band buffer: [8B u64 buf_len][pad to 64B][buf bytes][pad]
 Buffers are 64-byte aligned so numpy views are alignment-friendly.
+The leading total word makes the layout SELF-TERMINATING: a segment
+carved from a larger recycled/prewarmed pool file needs no exact-size
+truncate (which frees the warm tail pages this pool exists to keep) —
+readers parse to `total` and ignore any slack tail, and the word rides
+along byte-identically through chunked streams and cross-node copies.
 """
 
 from __future__ import annotations
@@ -51,21 +56,34 @@ def _align(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
 
+def _advise_hugepage(mm: mmap.mmap) -> None:
+    """Best-effort THP hint: on hosts with shmem THP enabled
+    (/sys/kernel/mm/transparent_hugepage/shmem_enabled = advise) this
+    roughly halves large-copy TLB pressure; everywhere else it's a
+    no-op. Never fatal."""
+    try:
+        mm.madvise(mmap.MADV_HUGEPAGE)
+    except (AttributeError, OSError, ValueError):
+        pass
+
+
 def _segment_layout(header: bytes, raws: List[memoryview]):
     """Compute (total_size, [(offset, part), ...]) for a segment.
     Parts are either bytes (metadata words) or the raw buffers."""
     parts: List[Tuple[int, Any]] = [
-        (0, struct.pack("<Q", len(header))),
-        (8, header),
+        (8, struct.pack("<Q", len(header))),
+        (16, header),
     ]
-    pos = 8 + len(header)
+    pos = 16 + len(header)
     for r in raws:
         pos = _align(pos)
         parts.append((pos, struct.pack("<Q", r.nbytes)))
         pos = _align(pos + 8)
         parts.append((pos, r))
         pos += r.nbytes
-    return _align(pos), parts
+    total = _align(pos)
+    parts.insert(0, (0, struct.pack("<Q", total)))
+    return total, parts
 
 
 def iter_segment_chunks(header: bytes, raws: List[memoryview],
@@ -119,9 +137,14 @@ class MappedSegment:
     the warm pool on free() (a reader recycling a segment another
     process also pooled would double-assign the same pages).
     `size` is the logical object size; the mmap may be longer when the
-    segment was carved from a recycled file."""
+    segment was carved from a recycled file.
+    `faulted` means this mapping's pages have been WRITTEN THROUGH (its
+    PTEs are populated): a put into it is a pure memcpy (~8 GiB/s here)
+    instead of 64Ki soft faults + memcpy (~1.4 GiB/s). The cold path
+    writes via os.write — the file's pages exist but this mapping never
+    faulted them — so only pool-path/prewarmed segments qualify."""
 
-    __slots__ = ("path", "mm", "size", "writable")
+    __slots__ = ("path", "mm", "size", "writable", "faulted")
 
     def __init__(self, path: str):
         self.path = path
@@ -131,8 +154,10 @@ class MappedSegment:
             self.mm = mmap.mmap(fd, st.st_size)
         finally:
             os.close(fd)
+        _advise_hugepage(self.mm)
         self.size = st.st_size
         self.writable = False
+        self.faulted = False
 
     @classmethod
     def from_fd(cls, path: str, fd: int, size: int) -> "MappedSegment":
@@ -142,8 +167,10 @@ class MappedSegment:
         seg = cls.__new__(cls)
         seg.path = path
         seg.mm = mmap.mmap(fd, size)
+        _advise_hugepage(seg.mm)
         seg.size = size
         seg.writable = True
+        seg.faulted = False
         return seg
 
 
@@ -171,17 +198,85 @@ class ShmObjectStore:
         return os.path.join(self.dir, name)
 
     def _pool_take(self, total: int) -> Optional[MappedSegment]:
-        """Pop the smallest pooled segment whose mmap covers `total`."""
+        """Pop the smallest pooled segment whose mmap covers `total`,
+        preferring already-faulted mappings: a recycled cold-path
+        segment (file pages warm, mapping unfaulted) must not best-fit
+        its way ahead of a prewarmed/pool-written one — the faulted
+        mapping copies ~5x faster (see MappedSegment.faulted)."""
         with self._lock:
             best = -1
-            for i, (cap, _) in enumerate(self._pool):
-                if cap >= total and (best < 0 or cap < self._pool[best][0]):
+            for i, (cap, seg) in enumerate(self._pool):
+                if cap < total:
+                    continue
+                if best < 0:
+                    best = i
+                    continue
+                bcap, bseg = self._pool[best]
+                if (seg.faulted, -cap) > (bseg.faulted, -bcap):
                     best = i
             if best < 0:
                 return None
             cap, seg = self._pool.pop(best)
             self._pool_bytes -= cap
             return seg
+
+    def prewarm(self, nbytes: int) -> None:
+        """Fault `nbytes` of anonymous pooled segments through their
+        mappings (the plasma trick: the arena is faulted once at
+        startup, objects recycle its pages). Called from a background
+        thread at driver init, so by the first large put the pool
+        already holds warm pages and the put is a single memcpy. Split
+        into two segments when the budget allows: carving an object
+        from a much-larger segment truncates away its warm tail, so
+        right-sized halves beat one big arena. A pool-cap overflow or
+        any OS error just skips the optimization."""
+        if nbytes <= 0:
+            return
+        if nbytes >= 128 * 1024 * 1024:
+            half = nbytes // 2
+            self._prewarm_one(half)
+            self._prewarm_one(nbytes - half)
+        else:
+            self._prewarm_one(nbytes)
+
+    def _prewarm_one(self, nbytes: int) -> None:
+        name = f".pool.{uuid.uuid4().hex}"
+        path = os.path.join(self.dir, name)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+            try:
+                os.truncate(fd, nbytes)
+                seg = MappedSegment.from_fd(path, fd, nbytes)
+            finally:
+                os.close(fd)
+            # fault every page by writing through the mapping (writes —
+            # not reads — populate the PTEs; a read maps the shared
+            # zero page and the first real write still faults)
+            mm = seg.mm
+            step = 8 * 1024 * 1024
+            zeros = bytes(step)
+            for off in range(0, nbytes, step):
+                end = min(off + step, nbytes)
+                mm[off:end] = zeros[: end - off]
+            seg.faulted = True
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return
+        with self._lock:
+            if (
+                self._pool_bytes + nbytes <= _POOL_MAX_BYTES
+                and len(self._pool) < _POOL_MAX_SEGMENTS
+            ):
+                self._pool.append((nbytes, seg))
+                self._pool_bytes += nbytes
+                return
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
     def _layout(self, header: bytes, raws: List[memoryview]):
         return _segment_layout(header, raws)
@@ -209,10 +304,11 @@ class ShmObjectStore:
             pass
         seg = self._pool_take(total)
         if seg is not None:
-            # exact-size the file so readers parsing by st_size see the
-            # true layout; shrink drops only tail pages, equal-size
-            # round trips (the common case) keep every page warm
-            if os.path.getsize(seg.path) != total:
+            # grow-only: the self-terminating layout lets readers
+            # ignore a slack tail, so carving a smaller object from a
+            # larger recycled file never truncates (truncating would
+            # free exactly the warm tail pages the pool exists to keep)
+            if os.path.getsize(seg.path) < total:
                 os.truncate(seg.path, total)
             mm = seg.mm
             for off, part in parts:
@@ -231,6 +327,9 @@ class ShmObjectStore:
             os.rename(seg.path, path)
             seg.path = path
             seg.size = total
+            # the copy above wrote the object's span through the mmap;
+            # for recycled cold-path segments this is what faults them
+            seg.faulted = True
         else:
             fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
             try:
@@ -274,11 +373,14 @@ class ShmObjectStore:
                 self._segments[name] = seg
         mm = seg.mm
         view = memoryview(mm)
-        (hlen,) = struct.unpack_from("<Q", mm, 0)
-        header = bytes(view[8 : 8 + hlen])
-        off = _align(8 + hlen)
+        (total,) = struct.unpack_from("<Q", mm, 0)
+        if not 16 <= total <= seg.size:
+            total = seg.size  # defensive: never read past the mapping
+        (hlen,) = struct.unpack_from("<Q", mm, 8)
+        header = bytes(view[16 : 16 + hlen])
+        off = _align(16 + hlen)
         buffers: List[memoryview] = []
-        while off < seg.size:
+        while off < total:
             (blen,) = struct.unpack_from("<Q", mm, off)
             off = _align(off + 8)
             buffers.append(view[off : off + blen])

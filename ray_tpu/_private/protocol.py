@@ -69,11 +69,49 @@ OBJ_SPILL = "obj_spill"            # hub -> agent: move a segment to disk
 OBJ_RESTORE = "obj_restore"        # hub -> agent: move it back to shm
 FETCH_OBJECT = "fetch_object"      # client -> hub: pull a remote segment
                                    # (optional offset/length for chunked
-                                   # streaming to shm-less clients)
+                                   # streaming to shm-less clients). The
+                                   # hub-RELAY path: the out-of-band
+                                   # object plane (RESOLVE_OBJECT +
+                                   # object_agent.py) is tried first and
+                                   # falls back here; a "fallback" field
+                                   # on the first chunk records the
+                                   # object_transfer_fallback event
 PUT_CHUNK = "put_chunk"            # client -> hub: one slice of a large
                                    # put streamed over the connection
                                    # (reference: util/client/server/
-                                   # dataservicer.py chunked PutObject)
+                                   # dataservicer.py chunked PutObject).
+                                   # Carries an explicit "offset" so a
+                                   # replayed chunk (retransmit after a
+                                   # lost reply) rewrites the same bytes
+                                   # instead of corrupting the segment
+
+# ---- out-of-band object plane (reference: the ownership directory +
+# PullManager/object-manager direct transfer split, src/ray/
+# object_manager/ + core_worker/reference_count.h ownership): bulk
+# object bytes move peer<->peer over per-node object_agent endpoints
+# (object_agent.py), NOT through the hub reactor; the hub only answers
+# location queries and tracks the replica set.
+RESOLVE_OBJECT = "resolve_object"  # client -> hub: where does this shm
+                                   # object live? -> {name, size, node_id,
+                                   # endpoint, path, spilled}. Clients
+                                   # cache the answer; the cache is
+                                   # invalidated by the __obj_freed__ and
+                                   # __node_down__ pubsub channels
+REPLICA_ADDED = "replica_added"    # client -> hub (async): a direct fetch
+                                   # installed a copy of the segment on
+                                   # this node; the directory adds it to
+                                   # the object's replica set
+
+# ---- readiness push (reference: the core worker's object-ready
+# callbacks from the local memory store instead of polling GCS): a
+# wait() over not-ready refs subscribes ONCE; the hub pushes ready sets
+# as producing tasks finish, so a 1k-ref pop-loop costs one
+# subscription plus pushes instead of a round trip per poll.
+SUBSCRIBE_READY = "subscribe_ready"  # client -> hub: {object_ids} ->
+                                     # reply {ready: [...]} for the
+                                     # already-ready subset; the rest are
+                                     # registered for push
+READY_PUSH = "ready_push"            # hub -> client: {ready: [oids]}
 
 # hub -> worker
 EXEC_TASK = "exec_task"

@@ -154,6 +154,7 @@ def init(
         )
         _hub.start()
         _client = CoreClient(_hub.addr, _session_dir, role="driver", worker_id="driver")
+        _client.start_prewarm(store_cap=_hub.nodes["node0"].store_cap)
         _register_job_config(_client, job_config)
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             _subscribe_worker_logs(_client)
@@ -300,9 +301,13 @@ def wait(
     if num_returns <= 0:
         raise ValueError("num_returns must be > 0")
     client = get_client()
-    ready, not_ready = client.wait([r._id for r in refs], num_returns, timeout, fetch_local)
-    by_id = {r._id.binary(): r for r in refs}
-    return [by_id[b] for b in ready], [by_id[b] for b in not_ready]
+    # position-based mapping: the wait() pop-loop shape re-calls this
+    # with ~the same 1k refs per pop, so a per-call {id: ref} dict build
+    # was the dominant client-side cost of the drain (O(n^2) overall)
+    ready_pos, not_ready_pos = client.wait_pos(
+        [r._id.binary() for r in refs], num_returns, timeout
+    )
+    return [refs[i] for i in ready_pos], [refs[i] for i in not_ready_pos]
 
 
 def kill(actor, *, no_restart: bool = True) -> None:

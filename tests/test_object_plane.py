@@ -1,0 +1,312 @@
+"""Out-of-band object plane (PR 6): ownership directory + direct
+peer<->peer transfer (object_agent.py), hub-relay fallback under
+chaos, PUT_CHUNK replay idempotence, and readiness-push wait().
+
+Reference analogues: src/ray/object_manager/ (direct push/pull between
+stores, never through the GCS), core_worker reference_count.h
+(ownership directory), and the core worker's local-store ready
+callbacks (vs polling) for wait().
+"""
+
+import os
+import tempfile
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol as P
+
+
+BIG = 20 * 1024 * 1024  # > 2 FETCH_CHUNKs, so transfers are multi-chunk
+
+
+def _scratch_client(hub, hostname=None):
+    """A shm-less CoreClient with a private scratch store — the
+    in-process stand-in for ray_tpu.init(address=...) client mode."""
+    from ray_tpu._private.client import CoreClient
+
+    scratch = os.path.join(
+        tempfile.gettempdir(), f"rt_plane_{uuid.uuid4().hex[:8]}"
+    )
+    os.makedirs(scratch, exist_ok=True)
+    cl = CoreClient(
+        hub.addr, scratch, role="client",
+        worker_id=f"client_{uuid.uuid4().hex[:6]}",
+    )
+    cl.inline_only = True
+    if hostname is not None:
+        # defeat the same-host file-copy shortcut so the SOCKET path
+        # is exercised on this single-machine test box
+        cl.hostname = hostname
+    return cl
+
+
+@pytest.fixture
+def runtime():
+    ctx = ray_tpu.init(num_cpus=2, max_workers=2)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def chaos_agent_runtime(monkeypatch):
+    # every agent connection dies after serving/accepting ONE chunk:
+    # the "serving peer dies mid-transfer" scenario
+    monkeypatch.setenv("RAY_TPU_CHAOS_OBJECT_AGENT", "close_after:1")
+    ctx = ray_tpu.init(num_cpus=2, max_workers=2)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def _hub():
+    return ray_tpu._private.worker._hub
+
+
+def _fallback_events(hub):
+    return [e for e in hub.events if e["kind"] == "object_transfer_fallback"]
+
+
+# ---------------------------------------------------------------- direct path
+def test_direct_put_and_fetch_over_socket(runtime):
+    hub = _hub()
+    assert hub.object_agent is not None, "head object agent should be on"
+    cl = _scratch_client(hub, hostname="elsewhere-host")
+    try:
+        big = np.random.randint(0, 256, (BIG,), dtype=np.uint8)
+        # put: client-mode bytes stream straight to the head agent
+        oid = cl.put_value(big)
+        from ray_tpu._private import worker as w
+
+        got = w.get_client().get([oid])[0]
+        assert (got == big).all()
+        assert hub.object_agent.stats()["bytes_received"] >= BIG
+        # fetch: a driver-owned segment pulled over the agent socket
+        ref = ray_tpu.put(big + 1)
+        vals = cl.get([ref._id])
+        assert (vals[0] == big + 1).all()
+        assert hub.object_agent.stats()["bytes_served"] >= BIG
+        assert not _fallback_events(hub), "direct path must not fall back"
+        # location cached, then invalidated by the free broadcast
+        assert ref._id.binary() in cl._resolve_cache
+        ray_tpu.free([ref])
+        deadline = time.time() + 5
+        while ref._id.binary() in cl._resolve_cache and time.time() < deadline:
+            time.sleep(0.05)
+        assert ref._id.binary() not in cl._resolve_cache
+    finally:
+        cl.close()
+
+
+def test_same_host_fetch_uses_file_copy(runtime):
+    """A consumer on the producer's machine copies the segment file
+    directly — no sockets, no hub bytes."""
+    hub = _hub()
+    cl = _scratch_client(hub)  # real hostname: matches the head's
+    try:
+        big = np.random.randint(0, 256, (BIG,), dtype=np.uint8)
+        ref = ray_tpu.put(big)
+        served_before = hub.object_agent.stats()["bytes_served"]
+        vals = cl.get([ref._id])
+        assert (vals[0] == big).all()
+        assert hub.object_agent.stats()["bytes_served"] == served_before
+        assert not _fallback_events(hub)
+    finally:
+        cl.close()
+
+
+def test_direct_bytes_metric_exported(runtime):
+    hub = _hub()
+    cl = _scratch_client(hub, hostname="elsewhere-host")
+    try:
+        big = np.random.randint(0, 256, (BIG,), dtype=np.uint8)
+        ref = ray_tpu.put(big)
+        cl.get([ref._id])
+        deadline = time.time() + 10  # next head heartbeat samples stats
+        key = ("ray_tpu_object_direct_bytes", (("node_id", "node0"),))
+        while time.time() < deadline:
+            m = hub.metrics.get(key)
+            if m is not None and m["value"] >= BIG:
+                break
+            time.sleep(0.2)
+        assert hub.metrics.get(key) is not None
+        assert hub.metrics[key]["value"] >= BIG
+    finally:
+        cl.close()
+
+
+# ----------------------------------------------------- chaos: mid-stream death
+def test_agent_death_mid_fetch_falls_back_to_relay(chaos_agent_runtime):
+    hub = _hub()
+    cl = _scratch_client(hub, hostname="elsewhere-host")
+    try:
+        big = np.random.randint(0, 256, (BIG,), dtype=np.uint8)
+        ref = ray_tpu.put(big)
+        vals = cl.get([ref._id])  # agent dies after chunk 1 of >=3
+        assert (vals[0] == big).all(), "fallback value corrupted"
+        evs = _fallback_events(hub)
+        assert any(e["op"] == "fetch" for e in evs)
+        m = hub.metrics.get(("ray_tpu_object_fallbacks_total", ()))
+        assert m is not None and m["value"] >= 1
+    finally:
+        cl.close()
+
+
+def test_agent_death_mid_put_falls_back_to_relay(chaos_agent_runtime):
+    hub = _hub()
+    cl = _scratch_client(hub, hostname="elsewhere-host")
+    try:
+        big = np.random.randint(0, 256, (BIG,), dtype=np.uint8)
+        oid = cl.put_value(big)  # direct put dies -> PUT_CHUNK relay
+        from ray_tpu._private import worker as w
+
+        got = w.get_client().get([oid])[0]
+        assert (got == big).all()
+        assert any(e["op"] == "put" for e in _fallback_events(hub))
+    finally:
+        cl.close()
+
+
+# ------------------------------------------------- PUT_CHUNK replay idempotence
+def test_put_chunk_replay_is_idempotent(tmp_path):
+    """A retransmitted chunk (reply-loss replay) — including a
+    duplicate `last: True` — must neither corrupt the segment nor
+    double-advance the hub-side size accounting."""
+    from ray_tpu._private.hub import Hub
+
+    hub = Hub(str(tmp_path / "sess"), resources={"CPU": 1.0})
+    try:
+        conn = object()  # only identity + outbox key are used
+        oid = b"replay-test-oid"
+        name = "replayseg"
+        payload = os.urandom(64)
+        mid = os.urandom(32)
+        tail = os.urandom(16)
+
+        def chunk(offset, data, last=False):
+            hub._on_put_chunk(conn, {
+                "object_id": oid, "name": name,
+                "offset": offset, "data": data, "last": last,
+            })
+
+        chunk(0, payload)
+        chunk(64, mid)
+        chunk(64, mid)            # replayed middle chunk
+        chunk(96, tail, last=True)
+        e = hub.objects[oid]
+        assert e.ready and e.kind == P.VAL_SHM and e.size == 112
+        path = os.path.join(hub.session_dir, "objects", name)
+        with open(path, "rb") as f:
+            assert f.read() == payload + mid + tail
+        # duplicate last-chunk replay after completion: dropped whole
+        chunk(96, tail, last=True)
+        assert hub.objects[oid].size == 112
+        with open(path, "rb") as f:
+            assert f.read() == payload + mid + tail
+        assert not hub._client_puts, "replay must not reopen the stream"
+    finally:
+        hub._running = False
+        if hub.object_agent is not None:
+            hub.object_agent.close()
+        hub.listener.close()
+
+
+# ------------------------------------------------------------- readiness push
+def test_wait_pop_loop_uses_readiness_push(runtime):
+    from ray_tpu._private import worker as w
+
+    client = w.get_client()
+    pushed = []
+    orig = client._on_ready_push
+    client._inbound_handlers[P.READY_PUSH] = lambda p: (
+        pushed.extend(p.get("ready", ())), orig(p)
+    )
+
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(100)]
+    seen = set()
+    not_ready = refs
+    while not_ready:
+        ready, not_ready = ray_tpu.wait(not_ready, timeout=60)
+        seen.update(r._id.binary() for r in ready)
+    assert len(seen) == 100
+    assert pushed, "pop-loop should be served by READY_PUSH"
+    # subscriptions drained: nothing left registered hub-side
+    hub = _hub()
+    deadline = time.time() + 5
+    while hub._ready_watchers and time.time() < deadline:
+        time.sleep(0.05)
+    assert not hub._ready_watchers
+
+
+def test_wait_all_and_timeout_semantics(runtime):
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(50)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=50, timeout=60)
+    assert len(ready) == 50 and not not_ready
+
+    @ray_tpu.remote
+    def never():
+        time.sleep(600)
+
+    stuck = never.remote()
+    t0 = time.monotonic()
+    ready, not_ready = ray_tpu.wait([stuck], timeout=0.3)
+    assert not ready and not_ready == [stuck]
+    assert time.monotonic() - t0 < 5
+    # timeout=0: one non-blocking snapshot
+    ready, not_ready = ray_tpu.wait([stuck], timeout=0)
+    assert not ready and not_ready == [stuck]
+    ray_tpu.cancel(stuck, force=True)
+
+
+def test_wait_mixed_ready_ordering(runtime):
+    """Ready quota is filled in id order; extras stay in not_ready even
+    when already complete (Ray wait() contract)."""
+    done = [ray_tpu.put(i) for i in range(4)]
+    ready, not_ready = ray_tpu.wait(done, num_returns=2, timeout=30)
+    assert len(ready) == 2 and len(not_ready) == 2
+    assert [r._id for r in ready] == [r._id for r in done[:2]]
+
+
+# ------------------------------------------------------ cluster invalidation
+def test_node_down_invalidates_resolve_cache(shutdown_only):
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_num_cpus=2)
+    try:
+        node = cluster.add_node(num_cpus=2, resources={"away": 4.0})
+
+        @ray_tpu.remote(resources={"away": 1.0})
+        def make():
+            return np.arange(500_000, dtype=np.float64)
+
+        ref = make.remote()
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+        assert ready
+        from ray_tpu._private import worker as w
+
+        client = w.get_client()
+        info = client._resolve_object(ref._id.binary())
+        assert info is not None and info["node_id"] == node.node_id
+        assert ref._id.binary() in client._resolve_cache
+        cluster.remove_node(node)
+        deadline = time.time() + 10
+        while (
+            ref._id.binary() in client._resolve_cache
+            and time.time() < deadline
+        ):
+            time.sleep(0.1)
+        assert ref._id.binary() not in client._resolve_cache, (
+            "stale location survived node death"
+        )
+    finally:
+        cluster.shutdown()
