@@ -12,13 +12,17 @@ and a trailing summary line. Baselines were measured on an m4.16xlarge
 box has 1-2 cores), so treat vs_baseline as directional for the
 control-plane rows and exact for the in-memory ones.
 
-Run: python bench_core.py [--quick] [--smoke] [--json PATH]
+Run: python bench_core.py [--quick] [--smoke] [--trials N] [--json PATH]
 
---quick    one trial with reduced iteration counts (the mode perf PRs
-           commit before/after JSON from; see README "Benchmarking")
+--quick    reduced iteration counts (the mode perf PRs commit
+           before/after JSON from; see README "Benchmarking")
 --smoke    micro-iterations only: every BASELINES metric still runs and
            is reported, but with counts sized for a CI smoke test
            (tests/test_bench_harness.py); numbers are NOT comparable
+--trials N measure every row N times and report the MEDIAN, with the
+           per-trial values recorded under "trials" in each JSON row.
+           Best-of-1 on a shared box is noise (BENCH_NOTE.md): perf
+           evidence should be median-of-3 or better.
 --json     also write {"metrics": {...}, "geomean_vs_baseline": N} to
            PATH (the BENCH_pr*_{before,after}.json convention)
 """
@@ -68,6 +72,7 @@ BASELINES = {
 
 SMOKE = False
 QUICK = False
+TRIALS = None  # --trials N: median-of-N, per-trial values in the JSON
 JSON_PATH = None
 RESULTS = []
 
@@ -76,9 +81,16 @@ def _parse_argv(argv) -> None:
     """Flag parsing stays out of import time: tests import this module
     for BASELINES, and pytest's argv must neither configure a bench
     mode nor trip the --json validation sys.exit at collection."""
-    global SMOKE, QUICK, JSON_PATH
+    global SMOKE, QUICK, TRIALS, JSON_PATH
     SMOKE = "--smoke" in argv
     QUICK = "--quick" in argv or SMOKE
+    if "--trials" in argv:
+        try:
+            TRIALS = int(argv[argv.index("--trials") + 1])
+        except (IndexError, ValueError):
+            sys.exit("--trials requires an integer argument")
+        if TRIALS < 1:
+            sys.exit("--trials must be >= 1")
     if "--json" in argv:
         try:
             JSON_PATH = argv[argv.index("--json") + 1]
@@ -90,7 +102,11 @@ def _parse_argv(argv) -> None:
             )
 
 
-def report(metric: str, value: float, unit: str) -> None:
+def report(metric: str, value, unit: str) -> None:
+    trials_list = None
+    if isinstance(value, list):  # --trials mode: timeit returned samples
+        trials_list = [round(v, 3) for v in value]
+        value = float(np.median(value))
     base = BASELINES.get(metric)
     rec = {
         "metric": metric,
@@ -98,14 +114,28 @@ def report(metric: str, value: float, unit: str) -> None:
         "unit": unit,
         "vs_baseline": round(value / base, 3) if base else None,
     }
+    if trials_list is not None:
+        rec["trials"] = trials_list
     RESULTS.append(rec)
     print(json.dumps(rec), flush=True)
 
 
-def timeit(fn, warmup: int = 1, trials: int = 3) -> float:
-    """Best-of-trials ops/s from fn() -> ops count."""
+def timeit(fn, warmup: int = 1, trials: int = 3):
+    """ops/s from fn() -> ops count. Default: best-of-trials (one trial
+    in --quick mode). With --trials N: the N per-trial values are
+    returned as a list and report() records median + all samples —
+    best-of-1 noise on a loaded box is exactly what multi-trial
+    medians exist to kill (BENCH_NOTE.md)."""
     for _ in range(warmup):
         fn()
+    if TRIALS:
+        samples = []
+        for _ in range(TRIALS):
+            t0 = time.perf_counter()
+            n = fn()
+            dt = time.perf_counter() - t0
+            samples.append(n / dt)
+        return samples
     best = 0.0
     for _ in range(1 if QUICK else trials):
         t0 = time.perf_counter()
@@ -418,6 +448,7 @@ def main() -> None:
             json.dump(
                 {
                     "mode": "smoke" if SMOKE else ("quick" if QUICK else "full"),
+                    "trials": TRIALS or 1,
                     "metrics": {r["metric"]: r for r in RESULTS},
                     "geomean_vs_baseline": round(geomean, 3),
                 },
@@ -451,14 +482,20 @@ def _smoke_direct_put_row() -> None:
     try:
         big = np.random.randint(0, 256, (4 * 1024 * 1024,), dtype=np.uint8)
         cl.free([cl.put_value(big)])  # warm the path
-        t0 = _time.perf_counter()
-        n = 4
-        for _ in range(n):
-            cl.free([cl.put_value(big)])
-        dt = _time.perf_counter() - t0
+
+        def one_trial():
+            t0 = _time.perf_counter()
+            n = 4
+            for _ in range(n):
+                cl.free([cl.put_value(big)])
+            return n * big.nbytes / (1024 ** 3) / (
+                _time.perf_counter() - t0
+            )
+
+        samples = [one_trial() for _ in range(TRIALS or 1)]
         report(
             "single_client_put_gigabytes_direct",
-            n * big.nbytes / (1024 ** 3) / dt, "GiB/s",
+            samples if TRIALS else samples[0], "GiB/s",
         )
     finally:
         cl.close()
@@ -505,23 +542,22 @@ def _bench_client_mode() -> None:
     ctx = ray_tpu.init(num_cpus=2, max_workers=2, _tcp_hub=True)
     addr = ctx.address_info["address"]
     try:
-        try:
-            report(
-                "single_client_put_gigabytes_direct",
-                _client_put_rate(addr, {"RAY_TPU_OBJECT_DIRECT": "1"}),
-                "GiB/s",
-            )
-        except Exception as e:  # noqa: BLE001
-            print(f"single_client_put_gigabytes_direct failed: {e}",
-                  file=sys.stderr)
-        try:
-            report(
-                "client_put_gigabytes",
-                _client_put_rate(addr, {"RAY_TPU_OBJECT_DIRECT": "0"}),
-                "GiB/s",
-            )
-        except Exception as e:  # noqa: BLE001
-            print(f"client_put_gigabytes failed: {e}", file=sys.stderr)
+        # --trials applies here too: each trial is one client
+        # subprocess run, so these rows carry the same median +
+        # per-trial evidence as the in-process ones
+        for metric, env_extra in (
+            ("single_client_put_gigabytes_direct",
+             {"RAY_TPU_OBJECT_DIRECT": "1"}),
+            ("client_put_gigabytes", {"RAY_TPU_OBJECT_DIRECT": "0"}),
+        ):
+            try:
+                samples = [
+                    _client_put_rate(addr, env_extra)
+                    for _ in range(TRIALS or 1)
+                ]
+                report(metric, samples if TRIALS else samples[0], "GiB/s")
+            except Exception as e:  # noqa: BLE001
+                print(f"{metric} failed: {e}", file=sys.stderr)
     finally:
         ray_tpu.shutdown()
 

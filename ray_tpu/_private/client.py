@@ -1185,6 +1185,31 @@ class CoreClient:
                     except Exception:
                         pass
             try:
+                # Half-close the stream BEFORE closing the fd: the reader
+                # thread is blocked in os.read() and that in-flight read
+                # keeps the open file description alive past conn.close(),
+                # so no FIN ever reaches the hub — which then keeps this
+                # connection (and every registry keyed on it: fairsched
+                # jobs, subscriptions, ready-watches) until process exit.
+                # shutdown() on a dup'd handle tears the stream down under
+                # the blocked read: the reader sees EOF immediately and
+                # the hub's reactor (or owning shard) gets its disconnect.
+                import socket as _socket
+
+                fd = os.dup(self.conn.fileno())
+                try:
+                    s = _socket.socket(fileno=fd)
+                except OSError:
+                    os.close(fd)
+                else:
+                    try:
+                        s.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    s.close()
+            except Exception:
+                pass
+            try:
                 self.conn.close()
             except Exception:
                 pass

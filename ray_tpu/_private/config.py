@@ -33,6 +33,11 @@ _DEFAULTS: Dict[str, Any] = {
     # objects with slack, so carving one truncates away only a few MiB
     # of warm tail pages). 0 = off.
     "segment_prewarm_bytes": 2 * 264 * 1024 * 1024,
+    # control plane: reactor shard count for the hub. 0 = auto
+    # (min(4, cpu count)); 1 = the original single-reactor loop
+    # (byte-for-byte identical wire behavior); N>1 = N reactor shard
+    # threads + a state-plane thread (hub_shards.py)
+    "hub_shards": 0,
     # scheduling / workers
     "worker_reap_period_s": 1.0,
     "max_pending_spawns_per_node": 32,

@@ -40,6 +40,7 @@ job/tenant registers.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -129,7 +130,12 @@ def _work(resources: Dict[str, float]) -> float:
 
 
 class FairScheduler:
-    """Policy engine owned by (and only touched from) the hub reactor.
+    """Policy engine owned by (and only touched from) the scheduler
+    state service — the hub's state-plane thread (with the sharded
+    control plane, hub_shards.py, reactor shards never call in here;
+    they deliver messages over the rings and this engine runs behind
+    them, so quota/priority ordering stays globally consistent no
+    matter which shard a submit arrived on).
 
     ``clock`` is injectable for deterministic tests (defaults to
     ``time.monotonic``).
@@ -146,6 +152,28 @@ class FairScheduler:
         # task_id -> (tenant, work) running fair-share interval
         self._running: Dict[bytes, Tuple[str, float]] = {}
         self.preemptions = 0
+        # single-owner discipline: the state plane binds itself before
+        # the first message and mutating entry points cheaply verify it
+        # (a reactor shard mutating policy state is the GL010 bug class
+        # — this is the runtime tripwire for the same invariant)
+        self._owner_ident: Optional[int] = None
+
+    def bind_owner(self) -> None:
+        """Called by the owning thread (hub state plane) at loop start."""
+        self._owner_ident = threading.get_ident()
+
+    def _assert_owner(self) -> None:
+        # sits on the per-submit admit() path once tenants exist: two
+        # attribute loads and a compare, nothing heavier
+        if (
+            self._owner_ident is not None
+            and threading.get_ident() != self._owner_ident
+        ):
+            raise RuntimeError(
+                "FairScheduler mutated off its owner thread — state "
+                "services are single-threaded; route through the "
+                "shard ring instead (see hub_shards.py)"
+            )
 
     # ------------------------------------------------------------ registry
     def active(self) -> bool:
@@ -164,6 +192,7 @@ class FairScheduler:
         dict — is declared and wins (one quota per tenant, shared by
         all its jobs, last declaration wins; ``quota={}`` lifts an
         earlier cap)."""
+        self._assert_owner()
         tenant = tenant or DEFAULT_TENANT
         entry = self.jobs.get(job_id)
         if entry is None:
@@ -239,6 +268,7 @@ class FairScheduler:
         so retries re-admit for free."""
         if not self.tenants:
             return True  # no quotas/jobs registered: stay inert
+        self._assert_owner()
         if spec.task_id in self._admitted:
             return True  # retry of already-admitted work
         tenant_name = self.tenant_of(spec.options)

@@ -7,13 +7,23 @@ and its ClusterTaskManager/LocalTaskManager (src/ray/raylet/scheduling/),
 and the plasma metadata plane. On a TPU host the control plane does not
 need to be distributed the way Ray's is (scheduling decisions are
 node-local; cross-host coordination happens through jax.distributed and
-the collective layer), so a single-threaded event-loop hub gives us the
-same semantics with none of the cross-process consistency machinery.
+the collective layer), so an event-loop hub gives us the same semantics
+with none of the cross-process consistency machinery.
 
-Threading model: ONE router thread owns all state (no locks); it
-multiplexes every client connection plus a deadline heap for timeouts —
-the same single-reactor shape as the raylet's instrumented asio loop
-(reference: src/ray/common/asio/instrumented_io_context.h).
+Threading model: ONE state-plane thread owns all state (no locks); it
+multiplexes timeouts through a deadline heap. Connection I/O has two
+shapes, selected by RAY_TPU_HUB_SHARDS (config "hub_shards", default
+min(4, cpu count)):
+
+  - shards == 1: the state-plane thread IS the reactor — it owns every
+    socket too, the same single-reactor shape as the raylet's
+    instrumented asio loop (reference: src/ray/common/asio/
+    instrumented_io_context.h). This path is byte-for-byte the pre-shard
+    behavior.
+  - shards > 1: N reactor-shard threads own the sockets + wire codec
+    (hub_shards.py) and reach the scheduler / object-directory state
+    services over SPSC message rings — the GCS/raylet split re-done
+    natively in one process. State stays single-threaded either way.
 
 Scheduling: resource-based admission (CPU/TPU/custom resources +
 placement-group bundle accounting) then dispatch to an idle worker from
@@ -46,6 +56,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 from . import protocol as P
 from .debug import log_exc, proc_rss_bytes
 from .fairsched import FairScheduler, QuotaInfeasibleError
+from .hub_shards import ShardStats as _ShardStats
 from .ids import WorkerID
 from .serialization import (
     dumps_frame,
@@ -514,6 +525,23 @@ class Hub:
         # of rebuilding the interest set every tick. Created by _run —
         # it lives and dies with the reactor thread.
         self._selector: Optional[selectors.BaseSelector] = None
+        # ---- multi-reactor mode (hub_shards.py): with n_shards > 1,
+        # connection I/O moves to N reactor-shard threads and THIS
+        # thread becomes the state plane, hosting the scheduler and
+        # object-directory services behind per-shard SPSC rings.
+        from .hub_shards import StateService, resolve_shard_count
+
+        self.n_shards = resolve_shard_count(self.config.get("hub_shards", 0))
+        self._shards: list = []           # ReactorShard, sharded mode only
+        self._shard_rings: list = []      # shard -> state-plane rings
+        self._conn_shard: Dict[Any, int] = {}  # conn -> owning shard idx
+        self._state_evt = threading.Event()
+        # the two internally-owned state services; both execute on the
+        # state-plane thread (single consumer), reached by message only
+        self.state_services = {
+            "scheduler": StateService("scheduler", self._dispatch_msg),
+            "objects": StateService("objects", self._dispatch_msg),
+        }
         # messages drained from one peer per reactor wake before other
         # ready peers get a turn (a batch frame charges its message
         # count); the selector is level-triggered, so residual input
@@ -556,7 +584,10 @@ class Hub:
             except OSError:
                 log_exc("head object agent failed to start (relay only)")
         self._shutdown_evt = threading.Event()
-        self.thread = threading.Thread(target=self._run, daemon=True, name="ray-tpu-hub")
+        self.thread = threading.Thread(
+            target=self._run if self.n_shards == 1 else self._run_sharded,
+            daemon=True, name="ray-tpu-hub",
+        )
 
     # ------------------------------------------------------------------ wire
     def start(self):
@@ -579,6 +610,22 @@ class Hub:
         if not self._outbox:
             return
         outbox, self._outbox = self._outbox, {}
+        if self._shards:
+            # sharded mode: each peer's socket has exactly ONE writer —
+            # its owning reactor shard. Hand the batch over; the shard
+            # encodes the frame (wire codec on the shard thread) and
+            # counts the flush in its per-shard stats.
+            shard_of = self._conn_shard
+            shards = self._shards
+            for conn, msgs in outbox.items():
+                idx = shard_of.get(conn)
+                if idx is None:
+                    # peer never spoke (or already disconnected): there
+                    # is no owner to write through — drop rather than
+                    # interleave bytes into another shard's stream
+                    continue
+                shards[idx].post(conn, msgs)
+            return
         for conn, msgs in outbox.items():
             self._bm_flushes["value"] += 1
             self._bm_observe(self._bm_flush_size, float(len(msgs)))
@@ -602,16 +649,9 @@ class Hub:
         O(conns) epoll_ctl syscalls per wake; now registration happens
         once per accept and teardown once per disconnect, and a wake
         costs a single epoll_wait regardless of fan-in."""
-        self._add_timer(self.config.worker_reap_period_s, self._reap_workers)
-        if self.config.memory_usage_threshold > 0:
-            self._add_timer(
-                self.config.memory_monitor_period_s, self._memory_monitor
-            )
-        if self.config.node_heartbeat_period_s > 0:
-            self._add_timer(
-                self.config.node_heartbeat_period_s, self._head_heartbeat
-            )
+        self._seed_timers()
         self._record_event("hub_start", addr=self.addr)
+        self.fairsched.bind_owner()  # single-owner discipline tripwire
         sel = self._selector = selectors.DefaultSelector()
         lsock = self.listener._listener._socket  # raw fd for readiness polling
         sel.register(lsock, selectors.EVENT_READ, None)  # data=None => accept
@@ -628,11 +668,7 @@ class Hub:
             except Exception:
                 log_exc("flight recorder dump failed")
         # teardown
-        for w in self.workers.values():
-            self._kill_worker(w)
-        for conn in list(self.agent_conns):
-            self._send(conn, P.KILL, {})
-        self._flush_outbox()
+        self._teardown_runtime()
         if self.object_agent is not None:
             self.object_agent.close()
         try:
@@ -707,6 +743,218 @@ class Hub:
                     log_exc("hub reactor error (dropping conn)")
                     self._safe_disconnect(conn)
 
+    # ------------------------------------------------ sharded control plane
+    def _seed_timers(self) -> None:
+        """Periodic jobs shared by BOTH control-plane topologies — a
+        timer added here runs with shards=1 and shards>1 alike."""
+        self._add_timer(self.config.worker_reap_period_s, self._reap_workers)
+        if self.config.memory_usage_threshold > 0:
+            self._add_timer(
+                self.config.memory_monitor_period_s, self._memory_monitor
+            )
+        if self.config.node_heartbeat_period_s > 0:
+            self._add_timer(
+                self.config.node_heartbeat_period_s, self._head_heartbeat
+            )
+
+    def _teardown_runtime(self) -> None:
+        """Shared epilogue: stop workers/agents and flush the last
+        replies (both topologies run this before closing their I/O)."""
+        for w in self.workers.values():
+            self._kill_worker(w)
+        for conn in list(self.agent_conns):
+            self._send(conn, P.KILL, {})
+        self._flush_outbox()
+
+    def _run_sharded(self):
+        """State-plane main loop (n_shards > 1): reactor shards own the
+        sockets; this thread owns every table and both state services.
+        Mirrors _run's lifecycle (timers, fatal-error flight dump,
+        teardown) with socket I/O delegated to the shards."""
+        from .hub_shards import ReactorShard, ShardRing
+
+        self._seed_timers()
+        self._record_event("hub_start", addr=self.addr, shards=self.n_shards)
+        self.fairsched.bind_owner()  # this thread IS the state plane
+        rings = self._shard_rings = [
+            ShardRing(self._state_evt.set) for _ in range(self.n_shards)
+        ]
+        shards = self._shards = [
+            ReactorShard(
+                i, rings[i], self._drain_budget,
+                listener=self.listener if i == 0 else None,
+            )
+            for i in range(self.n_shards)
+        ]
+        for s in shards:
+            s.peers = shards
+        for s in shards:
+            s.start()
+        try:
+            self._state_loop(rings)
+        except Exception:
+            log_exc("hub state plane FATAL error")
+            try:
+                path = self.dump_flight_recorder("fatal_state_plane_error")
+                sys.stderr.write(f"[ray_tpu] flight recorder dumped to {path}\n")
+            except Exception:
+                log_exc("flight recorder dump failed")
+        # teardown — the shared epilogue, then stop the shards (each
+        # flushes its outbound ring once more so the KILLs get out)
+        self._teardown_runtime()
+        for s in shards:
+            s.stop()
+        for s in shards:
+            s.join(timeout=2.0)
+        for s in shards:
+            if not s.is_alive():
+                # nothing can post to a joined shard: safe to release
+                # its wake pipe (closing earlier risks a write into a
+                # recycled fd number)
+                s.close_wakeups()
+        if self.object_agent is not None:
+            self.object_agent.close()
+        try:
+            self.listener.close()
+        except Exception:
+            pass
+        for conn in list(self._conn_shard):
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self._conn_shard.clear()
+        self._shutdown_evt.set()
+
+    def _state_loop(self, rings) -> None:
+        from .hub_shards import CONN_LOST, SHARD_EVENT
+
+        services = self.state_services
+        while self._running:
+            now = time.monotonic()
+            while self.timers and self.timers[0][0] <= now:
+                _, _, cb = heapq.heappop(self.timers)
+                try:
+                    cb()
+                except Exception:
+                    log_exc("hub timer error")
+            self._flush_outbox()
+            timeout = None
+            if self.timers:
+                timeout = max(0.0, self.timers[0][0] - time.monotonic())
+            self._state_evt.wait(timeout)
+            self._state_evt.clear()
+            self._bm_wakeups["value"] += 1
+            for idx, ring in enumerate(rings):
+                for conn, service, msg_type, payload in ring.drain():
+                    if msg_type == CONN_LOST:
+                        self._conn_shard.pop(conn, None)
+                        self._safe_disconnect(conn)
+                        continue
+                    if msg_type == SHARD_EVENT:
+                        fields = dict(payload)
+                        kind = fields.pop("kind")
+                        self._record_event(kind, **fields)
+                        if kind == "shard_fatal":
+                            # a dead shard would otherwise half-kill the
+                            # hub: accepts stop (shard 0) or 1-in-N new
+                            # conns adopt into a ring nobody drains.
+                            # Fail LOUDLY like the single-reactor fatal
+                            # path: dump the post-mortem and tear the
+                            # session down so every peer sees EOF.
+                            log_exc_msg = (
+                                f"[ray_tpu] hub shard {fields.get('shard')} "
+                                "died; shutting the control plane down\n"
+                            )
+                            sys.stderr.write(log_exc_msg)
+                            try:
+                                path = self.dump_flight_recorder(
+                                    "shard_fatal")
+                                sys.stderr.write(
+                                    f"[ray_tpu] flight recorder dumped "
+                                    f"to {path}\n")
+                            except Exception:
+                                log_exc("flight recorder dump failed")
+                            self._running = False
+                        continue
+                    self._conn_shard[conn] = idx
+                    try:
+                        # per-frame guard, like the single-reactor loop:
+                        # a handler bug costs one frame, never the plane
+                        self._handle_sharded(conn, service, msg_type,
+                                             payload, services)
+                    except Exception:
+                        log_exc(f"hub state-plane error on {msg_type}")
+            self._flush_outbox()
+
+    def _handle_sharded(self, conn, service, msg_type, payload,
+                        services) -> None:
+        """_handle's sharded twin: route one shard-delivered message to
+        its state service. Chaos shares _handle's single decision point
+        (outer msg_type only); batch frames fan their inner messages
+        out to each message's owning service, preserving arrival
+        order. The only intended divergence from _handle is the
+        per-service accounting seam (StateService.handle)."""
+        if self._chaos_dropped(msg_type):
+            return  # injected message drop
+        if msg_type == "batch":
+            from .hub_shards import SERVICE_OF
+
+            sched = services["scheduler"]
+            objs = services["objects"]
+            for mt, pl in payload:
+                svc = objs if SERVICE_OF.get(mt) == "objects" else sched
+                svc.handle(conn, mt, pl)
+            return
+        services.get(service, services["scheduler"]).handle(
+            conn, msg_type, payload
+        )
+
+    def _merge_shard_metrics(self) -> None:
+        """Fold per-shard reactor counters (written only by their shard
+        threads; read-only here) into the registry as shard-labelled
+        builtin series, plus per-service message counts. Called at
+        scrape time (list_state("metrics") / flight dump) so the hot
+        path never pays for the merge. Single-reactor mode keeps the
+        original untagged series untouched."""
+        if not self._shards or not self._builtin_metrics:
+            return
+        for s in self._shards:
+            st = s.stats
+            tags = (("shard", str(s.idx)),)
+            self._bm(
+                "ray_tpu_hub_reactor_wakeups_total", "counter",
+                "reactor selector wake-ups", tags,
+            )["value"] = float(st.wakeups)
+            self._bm(
+                "ray_tpu_hub_drain_budget_saturated_total", "counter",
+                "bursts cut off by the per-peer drain budget with input "
+                "still pending", tags,
+            )["value"] = float(st.drain_saturated)
+            self._bm(
+                "ray_tpu_hub_outbox_flushes_total", "counter",
+                "per-peer outbox flushes (one frame each)", tags,
+            )["value"] = float(st.frames_sent)
+            self._bm(
+                "ray_tpu_hub_shard_conns", "gauge",
+                "connections owned by this reactor shard", tags,
+            )["value"] = float(st.conns)
+            m = self._bm(
+                "ray_tpu_hub_outbox_flush_messages", "histogram",
+                "messages coalesced per outbox flush", tags,
+                _ShardStats.FLUSH_BOUNDS,
+            )
+            m["sum"] = st.flush_sum
+            m["count"] = st.flush_count
+            for pair, c in zip(m["buckets"], st.flush_buckets):
+                pair[1] = c
+        for name, svc in self.state_services.items():
+            self._bm(
+                "ray_tpu_state_service_messages_total", "counter",
+                "messages handled by this state service",
+                (("service", name),),
+            )["value"] = float(svc.processed)
+
     def _head_heartbeat(self) -> None:
         """Self-sample the head node's gauges (remote hosts report the
         same numbers via node-agent heartbeats, _on_node_heartbeat)."""
@@ -759,10 +1007,13 @@ class Hub:
 
     # ------------------------------------------- builtin runtime metrics
     # handler latencies are tens of µs; placement can take seconds when
-    # a worker must spawn; flush sizes are message counts
+    # a worker must spawn; flush sizes are message counts. The flush
+    # bounds are THE shared constant (hub_shards.ShardStats) so the
+    # per-shard bucket merge in _merge_shard_metrics can never zip
+    # against mismatched boundaries.
     _LATENCY_BOUNDS = (50e-6, 200e-6, 1e-3, 5e-3, 25e-3, 0.1, 1.0)
     _PLACEMENT_BOUNDS = (1e-3, 5e-3, 25e-3, 0.1, 0.5, 2.0, 10.0)
-    _FLUSH_BOUNDS = (1.0, 4.0, 16.0, 64.0, 128.0, 512.0)
+    _FLUSH_BOUNDS = _ShardStats.FLUSH_BOUNDS
 
     def _bm(self, name: str, mtype: str, description: str = "",
             tags: tuple = (), boundaries: tuple = ()) -> dict:
@@ -910,9 +1161,14 @@ class Hub:
         self._bm_events_total["value"] += 1
 
     def _flight_doc(self, reason: str) -> dict:
+        try:
+            self._merge_shard_metrics()
+        except Exception:
+            pass  # post-mortem must survive a half-torn-down shard set
         return {
             "reason": reason,
             "dumped_at": time.time(),
+            "shards": self.n_shards,
             # copy every row: json.dump runs AFTER the retry window, so
             # handing it live dicts the reactor still mutates would
             # reintroduce the mid-iteration crash the retry guards
@@ -967,18 +1223,25 @@ class Hub:
         return path
 
     # -------------------------------------------------------------- dispatch
+    def _chaos_dropped(self, msg_type: str) -> bool:
+        """The ONE chaos-drop decision both topologies share: the
+        probability is checked against the frame's OUTER msg_type
+        (batch frames drop whole, never per inner message)."""
+        if not self._chaos:
+            return False
+        import random
+
+        prob = self._chaos.get(msg_type)
+        if prob and random.random() < prob:
+            self._record_event("chaos_drop", msg_type=msg_type)
+            return True
+        return False
+
     def _handle(self, conn, msg_type: str, payload):
         """Table dispatch against the {msg_type: bound_method} map built
-        in __init__ (no per-message reflection — GL007). The chaos-drop
-        hook keeps its original semantics: the probability is checked
-        against the frame's outer msg_type, exactly as before."""
-        if self._chaos:
-            import random
-
-            prob = self._chaos.get(msg_type)
-            if prob and random.random() < prob:
-                self._record_event("chaos_drop", msg_type=msg_type)
-                return  # injected message drop
+        in __init__ (no per-message reflection — GL007)."""
+        if self._chaos_dropped(msg_type):
+            return  # injected message drop
         if msg_type == "batch":
             for mt, pl in payload:
                 self._dispatch_msg(conn, mt, pl)
@@ -3842,8 +4105,41 @@ class Hub:
         elif kind == "events":
             items = list(self.events)
         elif kind == "metrics":
+            self._merge_shard_metrics()
             for m in self.metrics.values():
                 items.append(dict(m, buckets=[list(b) for b in m["buckets"]]))
+        elif kind == "shards":
+            # control-plane topology: one row per reactor shard plus a
+            # row per state service (sharded mode; a single-reactor hub
+            # reports its one implicit shard)
+            if self._shards:
+                for s in self._shards:
+                    st = s.stats
+                    items.append({
+                        "shard": s.idx, "conns": st.conns,
+                        "accepted": st.accepted, "wakeups": st.wakeups,
+                        "frames_sent": st.frames_sent,
+                        "drain_saturated": st.drain_saturated,
+                        "backpressure": st.backpressure,
+                    })
+                for name, svc in self.state_services.items():
+                    items.append({
+                        "service": name, "processed": svc.processed,
+                    })
+            else:
+                # same semantics as a shard's st.conns: every registered
+                # socket (workers, agents, drivers, clients) — derived
+                # from the live selector map minus the listener entry
+                sel = self._selector
+                n_conns = (
+                    max(0, len(sel.get_map()) - 1) if sel is not None else 0
+                )
+                items.append({
+                    "shard": 0,
+                    "conns": n_conns,
+                    "wakeups": int(self._bm_wakeups["value"]),
+                    "frames_sent": int(self._bm_flushes["value"]),
+                })
         elif kind == "timeline":
             # chrome://tracing "complete" events (reference: ray.timeline
             # via GCS task events -> chrome trace). Wall stamps position

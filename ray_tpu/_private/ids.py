@@ -13,8 +13,34 @@ from __future__ import annotations
 
 import os
 import binascii
+import threading
 
 _ID_LEN = 14  # bytes; 112 bits of randomness — collision-free in practice
+
+# Batched entropy: os.urandom is a syscall, and ID generation sits on
+# the submit hot path (TaskID + per-return ObjectID per call) — at 1k
+# submits/s the per-call syscalls measurably steal GIL time from the
+# in-process hub thread (BENCH_NOTE.md). One urandom refill serves 1024
+# IDs; the bytes come from the same CSPRNG, so collision behavior is
+# unchanged. Per-thread buffers keep this lock-free.
+_ID_POOL_IDS = 1024
+_entropy = threading.local()
+if hasattr(os, "register_at_fork"):
+    # a forked child must not replay the parent's pooled bytes (workers
+    # here are spawned, not forked — this is defense in depth)
+    os.register_at_fork(
+        after_in_child=lambda: setattr(_entropy, "buf", None)
+    )
+
+
+def _pooled_id_bytes() -> bytes:
+    buf = getattr(_entropy, "buf", None)
+    pos = getattr(_entropy, "pos", 0)
+    if buf is None or pos >= len(buf):
+        buf = _entropy.buf = os.urandom(_ID_LEN * _ID_POOL_IDS)
+        pos = 0
+    _entropy.pos = pos + _ID_LEN
+    return buf[pos:pos + _ID_LEN]
 
 
 class BaseID:
@@ -26,7 +52,7 @@ class BaseID:
 
     @classmethod
     def generate(cls):
-        return cls(os.urandom(_ID_LEN))
+        return cls(_pooled_id_bytes())
 
     @classmethod
     def from_hex(cls, hex_str: str):

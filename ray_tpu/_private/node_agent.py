@@ -126,9 +126,19 @@ class NodeAgent:
         try:
             while True:
                 if self.conn.poll(1.0):
-                    blob = self.conn.recv_bytes()
-                    msg_type, payload = loads_frame(blob)
-                    self._handle(msg_type, payload)
+                    # bounded burst drain (the hub reactor's shape): a
+                    # spawn storm from the hub — now potentially fanned
+                    # out by several reactor shards at once — lands as
+                    # one wake + N handles instead of N one-second poll
+                    # cycles. The budget keeps reaping/heartbeats live.
+                    budget = 64
+                    while True:
+                        blob = self.conn.recv_bytes()
+                        msg_type, payload = loads_frame(blob)
+                        self._handle(msg_type, payload)
+                        budget -= 1
+                        if budget <= 0 or not self.conn.poll(0):
+                            break
                 self._reap()
                 now = time.monotonic()
                 if hb_period > 0 and now - last_hb >= hb_period:

@@ -61,8 +61,11 @@ class ObjectAgent:
 
     Thread-per-connection blocking IO: transfers are few and long, the
     per-chunk work is kernel bulk copies that release the GIL, and a
-    slow peer then stalls only its own thread — the property the hub
-    reactor cannot offer.
+    slow peer then stalls only its own thread — a property no control-
+    plane reactor (the single hub loop, or a reactor shard in the
+    RAY_TPU_HUB_SHARDS>1 topology, hub_shards.py) should offer: bulk
+    bytes on a reactor thread would park every peer's dispatch behind a
+    memcpy.
     """
 
     def __init__(self, objects_dir: str, spill_dir: str = "",

@@ -7,6 +7,12 @@ AF_UNIX sockets (multiprocessing.connection) — one hub, star topology.
 Bulk data never rides these messages; it goes through the shm object
 store (object_store.py).
 
+The hub end of every connection may be a single reactor or one of N
+reactor shards (RAY_TPU_HUB_SHARDS, hub_shards.py); the protocol is
+identical either way — sharding is invisible on the wire. The only
+per-connection guarantee clients rely on is FIFO delivery of their own
+messages, which each owning shard preserves end-to-end.
+
 Every message is a (msg_type:str, payload:dict) pair encoded with
 serialization.dumps_frame. Frames carry a one-byte codec marker:
 ``b"P"`` (stdlib pickle — the fast path; control frames are dicts of

@@ -303,9 +303,10 @@ def wait(
     client = get_client()
     # position-based mapping: the wait() pop-loop shape re-calls this
     # with ~the same 1k refs per pop, so a per-call {id: ref} dict build
-    # was the dominant client-side cost of the drain (O(n^2) overall)
+    # was the dominant client-side cost of the drain (O(n^2) overall);
+    # _bin is the construction-time cached raw id (one slot load/ref)
     ready_pos, not_ready_pos = client.wait_pos(
-        [r._id.binary() for r in refs], num_returns, timeout
+        [r._bin for r in refs], num_returns, timeout
     )
     return [refs[i] for i in ready_pos], [refs[i] for i in not_ready_pos]
 

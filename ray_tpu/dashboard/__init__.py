@@ -7,6 +7,9 @@ chrome-trace timeline, and job submission/inspection:
 
     GET  /api/cluster_status     nodes + aggregate resources
     GET  /api/nodes|actors|tasks|workers|objects|placement_groups
+    GET  /api/shards             control-plane topology: per-reactor-
+                                 shard conn/frame counters + state-
+                                 service message counts (hub_shards.py)
     GET  /api/timeline           chrome://tracing JSON
     GET  /api/events             flight-recorder runtime events
     GET  /metrics                Prometheus text (user + ray_tpu_* builtin)
@@ -69,7 +72,7 @@ class Dashboard:
             kind = request.match_info["kind"]
             allowed = {
                 "nodes", "actors", "tasks", "workers", "objects",
-                "placement_groups", "events", "tenants",
+                "placement_groups", "events", "tenants", "shards",
             }
             if kind not in allowed:
                 raise web.HTTPNotFound(text=f"unknown kind {kind}")

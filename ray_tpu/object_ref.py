@@ -16,10 +16,15 @@ from ._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owned", "_shared", "_hold", "__weakref__")
+    __slots__ = ("_id", "_bin", "_owned", "_shared", "_hold", "__weakref__")
 
     def __init__(self, object_id: ObjectID, *, _owned: bool = False):
         self._id = object_id
+        # raw id bytes, cached at construction: wait() pop-loops rebuild
+        # the id list of ~n refs per call (O(n^2) per drain), so the
+        # per-ref cost there must be one slot load, not an attr+method
+        # chain (single_client_wait_1k_refs)
+        self._bin = object_id.binary()
         # strong refs this ref keeps alive: owned twins of args the
         # submitter spilled to the object store — when the caller drops
         # its last return ref, the twins die and ownership GC frees the
@@ -36,7 +41,7 @@ class ObjectRef:
         self._shared = False
 
     def binary(self) -> bytes:
-        return self._id.binary()
+        return self._bin
 
     def hex(self) -> str:
         return self._id.hex()
@@ -62,7 +67,7 @@ class ObjectRef:
 
             client = worker._client
             if client is not None and not client._closed:
-                client.release_owned(self._id.binary())
+                client.release_owned(self._bin)
         except Exception:
             pass  # interpreter teardown / connection already gone
 

@@ -82,6 +82,9 @@ def cmd_start(args) -> None:
     if args.head:
         import ray_tpu
 
+        if args.hub_shards is not None:
+            # the hub reads config at construction; env is the handoff
+            os.environ["RAY_TPU_HUB_SHARDS"] = str(args.hub_shards)
         ctx = ray_tpu.init(
             num_cpus=args.num_cpus,
             num_tpus=args.num_tpus,
@@ -215,6 +218,9 @@ _LIST_COLUMNS = {
     "jobs": ["job_id", "tenant", "priority", "quota", "submitted",
              "dispatched", "preempted"],
     "tenants": ["tenant", "quota", "admitted", "share", "pending_quota"],
+    "shards": ["shard", "service", "conns", "accepted", "wakeups",
+               "frames_sent", "drain_saturated", "backpressure",
+               "processed"],
 }
 
 
@@ -449,6 +455,10 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--num-cpus", type=int, default=None)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.add_argument("--max-workers", type=int, default=None)
+    sp.add_argument("--hub-shards", type=int, default=None,
+                    help="reactor shard count for the head's control "
+                         "plane (0/unset = auto: min(4, cpu count); "
+                         "1 = single-reactor)")
     sp.add_argument("--node-id", default=None)
     add_address(sp)
     sp.set_defaults(fn=cmd_start)
@@ -464,7 +474,7 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "kind",
         choices=["actors", "tasks", "workers", "nodes", "objects",
-                 "placement_groups", "pgs", "jobs", "tenants"],
+                 "placement_groups", "pgs", "jobs", "tenants", "shards"],
     )
     sp.add_argument("--format", choices=["table", "json"], default="table")
     add_address(sp)

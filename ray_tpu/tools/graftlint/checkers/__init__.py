@@ -12,4 +12,5 @@ from . import (  # noqa: F401
     gl007_reflection_dispatch,
     gl008_wall_clock_duration,
     gl009_unbounded_registry,
+    gl010_cross_shard_state,
 )

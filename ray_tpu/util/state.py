@@ -46,6 +46,14 @@ def list_jobs(filters: Optional[list] = None) -> List[dict]:
     job table (job_submission.JobSubmissionClient.list_jobs)."""
     return _apply_filters(_client().list_state("jobs"), filters)
 
+def list_shards(filters: Optional[list] = None) -> List[dict]:
+    """Control-plane topology: one row per reactor shard (conns,
+    wakeups, frames sent) plus one per state service (messages
+    processed). A single-reactor hub reports its one implicit shard
+    (hub_shards.py; RAY_TPU_HUB_SHARDS)."""
+    return _apply_filters(_client().list_state("shards"), filters)
+
+
 def list_tenants(filters: Optional[list] = None) -> List[dict]:
     """Per-tenant scheduling accounting: quota vs admitted usage,
     fair-share clock, share of running work, pending_quota depth."""

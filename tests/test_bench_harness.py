@@ -21,7 +21,8 @@ def test_smoke_run_reports_every_baseline_metric(tmp_path):
     out_path = tmp_path / "bench.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
     r = subprocess.run(
-        [sys.executable, BENCH, "--smoke", "--json", str(out_path)],
+        [sys.executable, BENCH, "--smoke", "--trials", "2",
+         "--json", str(out_path)],
         capture_output=True, text=True, env=env, cwd=REPO_ROOT,
         timeout=420,
     )
@@ -29,6 +30,18 @@ def test_smoke_run_reports_every_baseline_metric(tmp_path):
 
     data = json.loads(out_path.read_text())
     assert data["mode"] == "smoke"
+    # --trials N schema: median value + the per-trial samples per row
+    assert data["trials"] == 2
+    for name, rec in data["metrics"].items():
+        trials = rec.get("trials")
+        if trials is not None:  # rows measured through timeit()
+            assert len(trials) == 2, name
+            # value is the 2-decimal-rounded median, trials are rounded
+            # at 3 decimals: compare with rounding slack, or two close
+            # samples near a 0.01 boundary flake the gate
+            assert (
+                min(trials) - 0.01 <= rec["value"] <= max(trials) + 0.01
+            ), (name, rec["value"], trials)
 
     sys.path.insert(0, REPO_ROOT)
     try:

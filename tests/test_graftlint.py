@@ -1117,6 +1117,94 @@ def test_reverting_fairsched_job_registry_prune_is_flagged():
     assert "GL009" not in codes_of(fixed)
 
 
+# --------------------------------------------------------------------- GL010
+
+
+def test_gl010_flags_shard_touching_hub_state():
+    # the bug class the multi-reactor split exists to remove: a reactor
+    # shard mutating hub tables directly from its own thread
+    src = """
+    class ReactorShard:
+        def __init__(self, hub):
+            self.hub = hub
+
+        def _drain_conn(self, conn):
+            blob = conn.recv_bytes()
+            self.hub.objects[blob] = True
+            self.hub.tasks.pop(blob, None)
+    """
+    codes = codes_of(src)
+    assert "GL010" in codes
+
+
+def test_gl010_flags_peer_shard_state_via_alias():
+    # aliasing a peer shard into a local does not launder the access
+    src = """
+    class ReactorShard:
+        def _accept(self, conn):
+            target = self.peers[0]
+            target.selector.register(conn)
+    """
+    assert "GL010" in codes_of(src)
+
+
+def test_gl010_clean_for_message_queue_api():
+    # the shipped shape: rings + the adopt/post control surface only
+    src = """
+    class ReactorShard:
+        def _accept(self, conn):
+            target = self.peers[0]
+            if target is self:
+                self._register(conn)
+            else:
+                target.adopt(conn)
+
+        def _drain_conn(self, conn):
+            blob = conn.recv_bytes()
+            self._state_ring.push((conn, None, "put", blob))
+
+        def _flush(self):
+            for conn, msgs in self.outbound.drain():
+                conn.send_bytes(msgs)
+    """
+    assert "GL010" not in codes_of(src)
+
+
+def test_gl010_ignores_non_reactor_classes():
+    # the state plane (Hub) legitimately owns hub/service state; only
+    # reactor-marked classes are in scope
+    src = """
+    class Hub:
+        def _state_loop(self, hub):
+            hub.objects.clear()
+            self.services.update({})
+    """
+    assert "GL010" not in codes_of(src)
+
+
+def test_reverting_shard_direct_disconnect_is_flagged():
+    """The real violation GL010 was written against: the first draft of
+    the shard refactor had ReactorShard._drop_conn calling
+    hub._handle_disconnect(conn) directly from the shard thread —
+    racing the state plane over every registry the cleanup touches.
+    The shipped shape pushes a CONN_LOST message instead. Re-applying
+    the direct call to the REAL hub_shards.py source must trip GL010."""
+    shards_path = os.path.join(PKG_DIR, "_private", "hub_shards.py")
+    with open(shards_path) as f:
+        real = f.read()
+    assert "GL010" not in {
+        f.code for f in check_file(shards_path, source=real)
+    }
+    reverted = real.replace(
+        "self._state_ring.push((conn, None, CONN_LOST, None))",
+        "self.hub._handle_disconnect(conn)",
+    )
+    assert reverted != real, "hub_shards.py no longer matches the revert"
+    assert "GL010" in {
+        f.code for f in check_file(shards_path, source=reverted)
+    }
+
+
 # ------------------------------------------------------------- repo gate
 
 
@@ -1140,5 +1228,5 @@ def test_every_checker_is_exercised_by_the_gate_config():
     codes = {code for code, _name, _fn in all_checkers()}
     assert codes == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009",
+        "GL008", "GL009", "GL010",
     }
