@@ -68,6 +68,14 @@ BASELINES = {
     "single_client_put_gigabytes_direct": 1.0,
     "single_client_wait_1k_refs_push": 2.5,
     "placement_group_create_removal": 752.0,
+    # net-new row (no reference analogue): throughput RETAINED with
+    # runtime tracing head-sampled at 1.0 vs off (single_client_tasks_
+    # async shape, each side its own subprocess cluster so init() reads
+    # the env). A ratio, so its baseline is 1.0 ("tracing off costs
+    # nothing"); reported for evidence, never gated — the gated rows
+    # measure the DEFAULT (sampling 0) path, which must stay in the 5%
+    # envelope.
+    "tracing_overhead": 1.0,
 }
 
 SMOKE = False
@@ -430,6 +438,10 @@ def main() -> None:
 
     ray_tpu.shutdown()
 
+    # tracing overhead: both sides need a FRESH cluster (sampling is
+    # read at init), so this runs after the main session is down
+    _bench_tracing_overhead()
+
     if not SMOKE:
         _bench_client_mode()
 
@@ -499,6 +511,72 @@ def _smoke_direct_put_row() -> None:
         )
     finally:
         cl.close()
+
+
+def _tasks_async_rate(env_extra: dict, n: int) -> float:
+    """One self-contained subprocess cluster running the
+    single_client_tasks_async shape; returns tasks/s. Used by the
+    tracing_overhead row: sampling is read at init, so on/off must be
+    separate processes (serial, same box — BENCH_NOTE.md)."""
+    import subprocess
+
+    script = f"""
+import sys; sys.path.insert(0, {json.dumps(os.path.dirname(os.path.abspath(__file__)))})
+import time
+import ray_tpu
+ray_tpu.init(num_cpus=4, max_workers=2)
+
+@ray_tpu.remote
+def nullary():
+    return b"ok"
+
+ray_tpu.get([nullary.remote() for _ in range(8)])  # warm the pool
+n = {n}
+t0 = time.perf_counter()
+ray_tpu.get([nullary.remote() for _ in range(n)])
+print("RATE", n / (time.perf_counter() - t0))
+ray_tpu.shutdown()
+"""
+    env = {**os.environ, **env_extra}
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True,
+        text=True, timeout=300, env=env,
+    )
+    rate = next(
+        (float(line.split()[1]) for line in out.stdout.splitlines()
+         if line.startswith("RATE")),
+        None,
+    )
+    if rate is None:
+        # surface the child's actual failure, not a bare StopIteration
+        raise RuntimeError(
+            f"bench subprocess rc={out.returncode}: "
+            f"{(out.stderr or out.stdout)[-400:]}"
+        )
+    return rate
+
+
+def _bench_tracing_overhead() -> None:
+    """tracing_overhead row: single_client_tasks_async with runtime
+    head-sampling at 1.0 vs off, reported as the on/off throughput
+    RATIO (1.0 = free; documented, not gated). Off runs first, with
+    --trials both sides run TRIALS times (off reduced to its median so
+    per-trial samples express the SAMPLED side's spread)."""
+    n = 40 if SMOKE else (1000 if QUICK else 5000)
+    off_env = {"RAY_TPU_TRACE_SAMPLE": "0", "RAY_TPU_TRACING": "0"}
+    on_env = {"RAY_TPU_TRACE_SAMPLE": "1.0"}
+    try:
+        off = [_tasks_async_rate(off_env, n) for _ in range(TRIALS or 1)]
+        off_med = float(np.median(off))
+        on = [_tasks_async_rate(on_env, n) for _ in range(TRIALS or 1)]
+    except Exception as e:  # noqa: BLE001
+        print(f"tracing_overhead failed: {e}", file=sys.stderr)
+        return
+    samples = [r / off_med for r in on]
+    report(
+        "tracing_overhead",
+        samples if TRIALS else samples[0], "ratio",
+    )
 
 
 def _client_put_rate(address: str, env_extra: dict) -> float:

@@ -17,6 +17,7 @@ from __future__ import annotations
 import itertools
 import os
 import queue
+import random
 import threading
 import time
 from concurrent.futures import Future
@@ -129,6 +130,24 @@ class CoreClient:
         # arrives (_on_ready_push) or on free; a stalled wait clears
         # its ids to force a re-sync (_wait_push retry period).
         self._ready_subscribed: set = set()
+        # ---- runtime tracing (util/tracing.py): head-sampling rate for
+        # this process's API calls. 0 (the default) keeps the hot paths
+        # nearly untouched: _tracing_live() gates all tracing work
+        # behind one attribute load + one contextvar read, and no
+        # "trace" field ever enters a payload.
+        from ..util import tracing as _tracing
+
+        self._trace_rate = _tracing.runtime_sample_rate()
+        self._trace_on = self._trace_rate > 0.0
+        # ambient-context probe, bound once: even with THIS process's
+        # sampling off, a live trace context (a traced task executing
+        # here while only the submitting driver samples — the hub and
+        # worker span paths are payload-driven) must keep stitching
+        self._trace_ctx = _tracing.current_context
+        # return-object id -> (trace_id, submit_span_id) for sampled
+        # submits, so the get() that collects a traced task's result
+        # joins its trace. FIFO-bounded like _resolve_cache.
+        self._trace_refs: Dict[bytes, tuple] = {}
         # multi-tenant scheduling identity (set by register_job): every
         # submit/PG-create from this client is stamped with it so the
         # hub's fairsched engine can order/quota/preempt per tenant
@@ -445,11 +464,108 @@ class CoreClient:
             # duplicate reply finds no pending future and is dropped)
             self.send(msg_type, payload)
 
+    # -------------------------------------------------------- runtime tracing
+    # All methods below are reached only behind `if self._tracing_live():`
+    # — with sampling off and no ambient context (the default) the
+    # submit/get/put hot paths pay one attribute load plus one
+    # contextvar read each.
+    def _tracing_live(self) -> bool:
+        return self._trace_on or self._trace_ctx() is not None
+    def _trace_begin(self):
+        """(trace_id, parent_span_id) for a new sampled operation:
+        inherit the ambient context (a user span, or a traced task's
+        execute scope in a worker — that's how nested submits stitch),
+        else head-sample a fresh trace."""
+        from ..util import tracing as _t
+
+        ctx = _t.current_context()
+        if ctx is not None:
+            return ctx
+        r = self._trace_rate
+        if r >= 1.0 or random.random() < r:
+            return (_t.new_span_id(), None)
+        return None
+
+    def _trace_emit(self, name: str, stage: str, trace_id: str,
+                    span_id: str, parent_id, t0: float, t1: float,
+                    **attrs) -> None:
+        """Ship one finished runtime span to the hub (batched onto the
+        existing connection; never raises into the traced path)."""
+        from ..util import tracing as _t
+
+        rec = _t.make_runtime_record(
+            name, stage, trace_id, parent_id, t0, t1, span_id=span_id,
+            node_id=self.node_id, **attrs,
+        )
+        try:
+            self.send_async(P.SPAN_RECORD, rec)
+        except Exception:
+            pass
+
+    def _traced_send(self, msg_type: str, payload: dict, span_name: str,
+                     stage: str, tr: tuple, remember_ids=(),
+                     t0: Optional[float] = None, **attrs) -> None:
+        """One sampled request: mint the span id, attach the trace
+        context to the payload, ship it, emit the client-side span, and
+        remember the return ids so a later get() joins the trace.
+        `t0` lets the span start before payload encoding (put path)."""
+        from ..util.tracing import new_span_id
+
+        span_id = new_span_id()
+        if t0 is None:
+            t0 = time.monotonic()
+        payload["trace"] = (tr[0], span_id)
+        self.send_async(msg_type, payload)
+        self._trace_emit(span_name, stage, tr[0], span_id, tr[1],
+                         t0, time.monotonic(), **attrs)
+        if remember_ids:
+            self._trace_remember(remember_ids, (tr[0], span_id))
+
+    def _trace_remember(self, return_ids, ctx: tuple) -> None:
+        # under the cache lock like every other client-side cache: a
+        # multi-threaded driver evicting concurrently (or racing a
+        # free()) must not KeyError inside the user's submit
+        with self._obj_cache_lock:
+            refs = self._trace_refs
+            for oid in return_ids:
+                refs[oid] = ctx
+            while len(refs) > 4096:  # FIFO bound; eviction = untraced get
+                refs.pop(next(iter(refs)), None)
+
+    def _trace_for_ids(self, oid_list) -> Optional[tuple]:
+        """Trace context for a get/fetch: ambient first, else the
+        remembered submit context of any requested ref."""
+        from ..util import tracing as _t
+
+        ctx = _t.current_context()
+        if ctx is not None:
+            return ctx
+        refs = self._trace_refs
+        if not refs:
+            return None
+        for oid in oid_list:
+            ctx = refs.get(oid)
+            if ctx is not None:
+                return ctx
+        return None
+
     # --------------------------------------------------------------- objects
     def put_value(self, obj: Any, object_id: Optional[ObjectID] = None) -> ObjectID:
         oid = object_id or ObjectID.generate()
-        kind, payload, size = self.encode_value(oid, obj)
-        self.send_async(P.PUT, {"object_id": oid.binary(), "kind": kind, "payload": payload, "size": size})
+        tr = self._trace_begin() if self._tracing_live() else None
+        if tr is None:
+            kind, payload, size = self.encode_value(oid, obj)
+            self.send_async(P.PUT, {"object_id": oid.binary(), "kind": kind, "payload": payload, "size": size})
+        else:
+            t0 = time.monotonic()  # the put span covers the encode too
+            kind, payload, size = self.encode_value(oid, obj)
+            self._traced_send(
+                P.PUT,
+                {"object_id": oid.binary(), "kind": kind,
+                 "payload": payload, "size": size},
+                "client.put", "put", tr,
+                remember_ids=[oid.binary()], t0=t0, size=size,
+            )
         if kind == P.VAL_SHM:
             # cache the deserialized original to avoid a re-map on local get
             with self._obj_cache_lock:
@@ -661,6 +777,25 @@ class CoreClient:
                     pass
 
     def _fetch_segment(self, oid_bytes: bytes, name: str) -> None:
+        tr = self._trace_for_ids((oid_bytes,)) if self._tracing_live() else None
+        if tr is None:
+            return self._fetch_segment_impl(oid_bytes, name)
+        from ..util.tracing import new_span_id
+
+        span_id = new_span_id()
+        t0 = time.monotonic()
+        try:
+            return self._fetch_segment_impl(oid_bytes, name)
+        finally:
+            # one span per installed segment: direct object-agent pull,
+            # same-host file copy, and the hub-relay fallback all count
+            # as the object plane's "transfer" stage
+            self._trace_emit(
+                "client.fetch_segment", "transfer", tr[0], span_id,
+                tr[1], t0, time.monotonic(), object=oid_bytes.hex(),
+            )
+
+    def _fetch_segment_impl(self, oid_bytes: bytes, name: str) -> None:
         """Install a remote segment into the local store: same-host
         file copy when the producer's objects dir is visible on this
         machine, direct object-agent stream otherwise, hub relay as the
@@ -757,6 +892,45 @@ class CoreClient:
                 pass
 
     def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+        if not self._tracing_live():
+            return self._get(object_ids, timeout)
+        ids = [o.binary() for o in object_ids]
+        tr = self._trace_for_ids(ids)
+        if tr is None:
+            return self._get(object_ids, timeout)
+        from ..util.tracing import new_span_id
+
+        span_id = new_span_id()
+        t0 = time.monotonic()
+        err = None
+        try:
+            return self._get(object_ids, timeout, trace=(tr[0], span_id))
+        except BaseException as exc:
+            err = type(exc).__name__
+            raise
+        finally:
+            attrs = {"n": len(ids)}
+            if err is not None:
+                attrs["error"] = err
+            # the get span ENVELOPS the wait for the result; the
+            # analyzer charges only its tail past the last runtime
+            # stage to "result_return"
+            self._trace_emit(
+                "client.get", "result_return", tr[0], span_id, tr[1],
+                t0, time.monotonic(), **attrs,
+            )
+            if err != "GetTimeoutError":
+                # terminal get: a LATER re-get of the same (now cached)
+                # ref must not re-emit and stretch the finished trace's
+                # end-to-end window; a timed-out get keeps its entries
+                # so the retry still stitches
+                with self._obj_cache_lock:
+                    for b in ids:
+                        self._trace_refs.pop(b, None)
+
+    def _get(self, object_ids: Sequence[ObjectID],
+             timeout: Optional[float] = None,
+             trace: Optional[tuple] = None) -> List[Any]:
         out: Dict[bytes, Any] = {}
         missing = []
         with self._obj_cache_lock:
@@ -766,9 +940,12 @@ class CoreClient:
                 else:
                     missing.append(oid)
         if missing:
+            req = {"object_ids": [o.binary() for o in missing], "timeout": timeout}
+            if trace is not None:
+                req["trace"] = trace
             reply = self.request(
                 P.GET,
-                {"object_ids": [o.binary() for o in missing], "timeout": timeout},
+                req,
                 timeout=None,
             )
             if reply.get("timeout"):
@@ -935,6 +1112,7 @@ class CoreClient:
                 self._obj_cache.pop(o.binary(), None)
                 self._known_ready.pop(o.binary(), None)
                 self._resolve_cache.pop(o.binary(), None)
+                self._trace_refs.pop(o.binary(), None)
         for o in object_ids:
             # drop any locally-fetched copy of a remote segment too
             self.store.free(o.hex())
@@ -1020,19 +1198,24 @@ class CoreClient:
         task_id = TaskID.generate()
         return_ids = [ObjectID.generate() for _ in range(num_returns)]
         self._stamp_job(options)
-        self.send_async(
-            P.SUBMIT_TASK,
-            {
-                "task_id": task_id.binary(),
-                "fn_id": fn_id,
-                "args_kind": args_kind,
-                "args_payload": args_payload,
-                "arg_deps": arg_dep_ids,
-                "return_ids": [r.binary() for r in return_ids],
-                "resources": resources,
-                "options": options,
-            },
-        )
+        payload = {
+            "task_id": task_id.binary(),
+            "fn_id": fn_id,
+            "args_kind": args_kind,
+            "args_payload": args_payload,
+            "arg_deps": arg_dep_ids,
+            "return_ids": [r.binary() for r in return_ids],
+            "resources": resources,
+            "options": options,
+        }
+        tr = self._trace_begin() if self._tracing_live() else None
+        if tr is None:
+            self.send_async(P.SUBMIT_TASK, payload)
+        else:
+            self._traced_send(
+                P.SUBMIT_TASK, payload, "client.submit", "submit", tr,
+                remember_ids=payload["return_ids"], fn_id=fn_id,
+            )
         if return_task_id:
             return task_id.binary(), return_ids
         return return_ids
@@ -1086,19 +1269,25 @@ class CoreClient:
         # identity must ride along so submits NESTED inside the method
         # inherit it (worker_process._adopt_job_identity)
         self._stamp_job(options)
-        self.send_async(
-            P.SUBMIT_ACTOR_TASK,
-            {
-                "task_id": task_id.binary(),
-                "actor_id": actor_id.binary(),
-                "method": method_name,
-                "args_kind": args_kind,
-                "args_payload": args_payload,
-                "arg_deps": arg_dep_ids,
-                "return_ids": [r.binary() for r in return_ids],
-                "options": options,
-            },
-        )
+        payload = {
+            "task_id": task_id.binary(),
+            "actor_id": actor_id.binary(),
+            "method": method_name,
+            "args_kind": args_kind,
+            "args_payload": args_payload,
+            "arg_deps": arg_dep_ids,
+            "return_ids": [r.binary() for r in return_ids],
+            "options": options,
+        }
+        tr = self._trace_begin() if self._tracing_live() else None
+        if tr is None:
+            self.send_async(P.SUBMIT_ACTOR_TASK, payload)
+        else:
+            self._traced_send(
+                P.SUBMIT_ACTOR_TASK, payload, "client.submit_actor",
+                "submit", tr, remember_ids=payload["return_ids"],
+                method=method_name,
+            )
         if return_task_id:
             return task_id.binary(), return_ids
         return return_ids
@@ -1154,8 +1343,10 @@ class CoreClient:
         reply = self.request(P.PG_READY, {"pg_id": pg_id, "timeout": timeout})
         return reply["ready"]
 
-    def list_state(self, kind: str) -> list:
-        return self.request(P.LIST_STATE, {"kind": kind})["items"]
+    def list_state(self, kind: str, **params) -> list:
+        # extra params pass through to the hub's _on_list_state (e.g.
+        # trace_id narrows kind="traces" to one trace's spans)
+        return self.request(P.LIST_STATE, dict(params, kind=kind))["items"]
 
     def cluster_resources(self, available: bool = False) -> dict:
         return self.request(P.CLUSTER_RESOURCES, {"available": available})["resources"]

@@ -167,6 +167,10 @@ class TaskSpec:
     # arg object ids pinned for this task's lifetime (cleared on unpin
     # so finalization paths can safely run more than once)
     pinned_deps: List[bytes] = field(default_factory=list)
+    # distributed tracing: (trace_id, client_submit_span_id) when the
+    # submit was head-sampled (util/tracing.py). None = untraced — every
+    # span-emission site gates on it, so the default path adds nothing.
+    trace: Optional[tuple] = None
 
 
 @dataclass
@@ -190,6 +194,12 @@ class WorkerEntry:
     # its chips are pinned for the worker's lifetime; the scheduler only
     # reuses it for tasks wanting the same chip count (chip affinity).
     pinned_chips: Optional[Tuple[int, ...]] = None
+    # tracing: monotonic spawn-request/HELLO stamps; the first traced
+    # task dispatched onto a freshly spawned worker attributes the
+    # spawn window to its trace as a "spawn" stage span (once)
+    spawned_t: float = 0.0
+    connected_t: float = 0.0
+    spawn_span_done: bool = False
 
 
 @dataclass
@@ -501,9 +511,32 @@ class Hub:
         self._event_seq = itertools.count()
         self.task_events: deque = deque(maxlen=int(self.config.task_events_max))
         self._task_event_index: Dict[bytes, dict] = {}
-        # user/library tracing spans (reference: ray.util.tracing's
-        # opentelemetry spans; here they land in the same timeline)
+        # tracing spans — user spans AND the runtime's own stage spans
+        # (reference: ray.util.tracing's opentelemetry spans; here they
+        # land in the same timeline). The flat deque feeds the
+        # chrome-trace timeline; _trace_index groups the same records
+        # per trace_id for list_state("traces") / the critical-path
+        # analyzer — both bounded (oldest trace evicted whole).
         self.spans: deque = deque(maxlen=int(self.config.task_events_max))
+        self._trace_index: Dict[str, list] = {}
+        # running per-trace summaries, maintained span-by-span so the
+        # list_state("traces") overview never rescans 512x1024 span
+        # dicts on the state-plane thread (evicted with the trace)
+        self._trace_summaries: Dict[str, dict] = {}
+        self._trace_max = 512          # distinct traces kept
+        self._trace_span_max = 1024    # spans kept per trace
+        # return-object id -> trace ctx for traced tasks in flight: the
+        # readiness push that unparks the caller's wait() stitches into
+        # the trace through this map (popped on push; FIFO-bounded)
+        self._traced_oids: Dict[bytes, tuple] = {}
+        # whether runtime tracing can be live at all — only consulted
+        # by reactor shards to decide whether to stamp ring-entry times
+        # (the state plane itself is payload-driven: a "trace" field in
+        # the message is the signal, so client-mode tracing works even
+        # when the head's own env has sampling off)
+        from ..util.tracing import runtime_sample_rate
+
+        self._trace_on = runtime_sample_rate() > 0.0
         self.driver_conn = None
         self._running = True
         self._dispatching = False
@@ -783,6 +816,7 @@ class Hub:
             ReactorShard(
                 i, rings[i], self._drain_budget,
                 listener=self.listener if i == 0 else None,
+                trace_on=self._trace_on,
             )
             for i in range(self.n_shards)
         ]
@@ -897,17 +931,39 @@ class Hub:
         per-service accounting seam (StateService.handle)."""
         if self._chaos_dropped(msg_type):
             return  # injected message drop
+        trace_on = self._trace_on  # shards only stamp when sampling is on
         if msg_type == "batch":
             from .hub_shards import SERVICE_OF
 
             sched = services["scheduler"]
             objs = services["objects"]
             for mt, pl in payload:
+                if trace_on and type(pl) is dict and "_ring_t" in pl:
+                    self._ring_wait_span(conn, pl)
                 svc = objs if SERVICE_OF.get(mt) == "objects" else sched
                 svc.handle(conn, mt, pl)
             return
+        if trace_on and type(payload) is dict and "_ring_t" in payload:
+            self._ring_wait_span(conn, payload)
         services.get(service, services["scheduler"]).handle(
             conn, msg_type, payload
+        )
+
+    def _ring_wait_span(self, conn, payload: dict) -> None:
+        """A traced message crossed a shard's SPSC ring: the owning
+        shard stamped its decode time (hub_shards._stamp_trace, the
+        shard's ONLY involvement — it never touches this span store,
+        GL010); the delta to now is the ring-wait stage."""
+        t_ring = payload.pop("_ring_t", None)
+        tr = payload.get("trace")
+        if t_ring is None or tr is None:
+            return
+        req_id = payload.get("req_id")
+        if req_id is not None and (id(conn), req_id) in self._inflight_reqs:
+            return  # retransmit of a parked request: one crossing span
+        self._emit_runtime_span(
+            "shard.ring_wait", "ring_wait", (tr[0], tr[1]),
+            t_ring, time.monotonic(),
         )
 
     def _merge_shard_metrics(self) -> None:
@@ -1154,6 +1210,15 @@ class Hub:
         ) - len(node.free_tpu_chips)
 
     # ------------------------------------------------ flight recorder
+    @staticmethod
+    def _trace_fields(spec) -> dict:
+        """Flight-recorder cross-link: when the task at hand is traced,
+        its events (task_retry/task_failed/preemption/...) carry the
+        trace_id so `ray_tpu events` and `ray_tpu trace` join up."""
+        if spec is not None and spec.trace is not None:
+            return {"trace_id": spec.trace[0]}
+        return {}
+
     def _record_event(self, kind: str, **fields) -> None:
         ev = {"seq": next(self._event_seq), "ts": time.time(), "kind": kind}
         ev.update(fields)
@@ -1306,6 +1371,7 @@ class Hub:
                 self.workers[wid] = w
             w.conn = conn
             w.state = "idle"
+            w.connected_t = time.monotonic()
             self.conn_to_worker[conn] = wid
             node = self.nodes.get(w.node_id)
             if node is not None:
@@ -1377,9 +1443,21 @@ class Hub:
         return "node0"  # driver and hub live on the head node
 
     def _on_put(self, conn, p):
+        tr = p.get("trace")
+        if tr is None:
+            self._object_ready(
+                p["object_id"], p["kind"], p["payload"], p.get("size", 0),
+                node_id=self._conn_node(conn),
+            )
+            return
+        t0 = time.monotonic()
         self._object_ready(
             p["object_id"], p["kind"], p["payload"], p.get("size", 0),
             node_id=self._conn_node(conn),
+        )
+        self._emit_runtime_span(
+            "hub.put", "put", (tr[0], tr[1]), t0, time.monotonic(),
+            object_id=p["object_id"].hex(), size=p.get("size", 0),
         )
 
     def _object_ready(self, oid: bytes, kind: str, payload: Any, size: int,
@@ -1524,6 +1602,25 @@ class Hub:
         self._reply(req.conn, req.req_id, values=values)
 
     def _on_get(self, conn, p):
+        tr = p.get("trace")
+        if tr is None or (id(conn), p["req_id"]) in self._inflight_reqs:
+            # untraced, or a ~2s retransmit of a still-parked request:
+            # one hub.get span per logical get, not one per resend (a
+            # get parked on a 60s task would otherwise burn ~30 spans
+            # of the trace's cap)
+            return self._handle_get(conn, p)
+        # handler time only — a parked GET's wait belongs to the
+        # producing task's stages, not to this span
+        t0 = time.monotonic()
+        try:
+            return self._handle_get(conn, p)
+        finally:
+            self._emit_runtime_span(
+                "hub.get", "get", (tr[0], tr[1]), t0, time.monotonic(),
+                n=len(p.get("object_ids", ())),
+            )
+
+    def _handle_get(self, conn, p):
         key = (id(conn), p["req_id"])
         if key in self._inflight_reqs:
             return  # retransmit of a still-parked request; reply will come
@@ -1805,6 +1902,16 @@ class Hub:
         watchers = self._ready_watchers.pop(oid, None)
         if not watchers:
             return
+        if self._traced_oids:
+            tr = self._traced_oids.pop(oid, None)
+            if tr is not None:
+                # near-instant marker: when the hub told the waiting
+                # client its traced result was ready (readiness push)
+                now = time.monotonic()
+                self._emit_runtime_span(
+                    "hub.ready_push", "ready_push", tr, now, now,
+                    object_id=oid.hex(), n_watchers=len(watchers),
+                )
         for conn in watchers:
             self._send(conn, P.READY_PUSH, {"ready": [oid]})
             watched = self._ready_watch_conns.get(id(conn))
@@ -2098,10 +2205,66 @@ class Hub:
                 still.append((min_consumed, conn, req_id))
         s.credit_waiters = still
 
-    # ----- metrics registry (reference: src/ray/stats/metric.h:104)
+    # ----- tracing spans (reference: ray.util.tracing + the task-event
+    # pipeline; here one store serves the timeline AND the per-trace
+    # critical-path queries)
     def _on_span_record(self, conn, p):
         """Finished tracing span from any process (util/tracing.py)."""
-        self.spans.append(p)
+        self._record_span(p)
+
+    def _record_span(self, rec: dict) -> None:
+        self.spans.append(rec)
+        tid = rec.get("trace_id")
+        if not tid:
+            return
+        idx = self._trace_index
+        summaries = self._trace_summaries
+        lst = idx.get(tid)
+        if lst is None:
+            lst = idx[tid] = []
+            summaries[tid] = {
+                "trace_id": tid, "n_spans": 0,
+                "start": rec["start"], "end": rec["end"],
+                "root": rec.get("name", ""), "rooted": False,
+                "procs": set(),
+            }
+            while len(idx) > self._trace_max:  # FIFO: oldest trace out
+                old = next(iter(idx))
+                idx.pop(old)
+                summaries.pop(old, None)
+        if len(lst) < self._trace_span_max:
+            lst.append(rec)
+            summ = summaries.get(tid)
+            if summ is not None:
+                summ["n_spans"] += 1
+                if rec["start"] < summ["start"]:
+                    summ["start"] = rec["start"]
+                if rec["end"] > summ["end"]:
+                    summ["end"] = rec["end"]
+                if rec.get("parent_id") is None and not summ["rooted"]:
+                    # the first parentless span is the trace root; until
+                    # one arrives the first span's name stands in
+                    summ["root"] = rec.get("name", "")
+                    summ["rooted"] = True
+                summ["procs"].add((rec.get("node_id"), rec.get("pid")))
+
+    def _emit_runtime_span(self, name: str, stage: str, trace: tuple,
+                           t0: float, t1: float,
+                           parent: Optional[str] = None,
+                           **attrs) -> str:
+        """Record one hub-side runtime span (state-plane thread only —
+        in sharded mode shards funnel their measurements through the
+        ring instead of calling this, GL010). Returns the span id so a
+        caller can parent further spans under it."""
+        from ..util.tracing import make_runtime_record
+
+        rec = make_runtime_record(
+            name, stage, trace[0],
+            parent if parent is not None else trace[1],
+            t0, t1, node_id="node0", **attrs,
+        )
+        self._record_span(rec)
+        return rec["span_id"]
 
     def _on_metric_record(self, conn, p):
         key = (p["name"], p["tags"])
@@ -2271,7 +2434,19 @@ class Hub:
             options=p["options"],
             retries_left=p["options"].get("max_retries", 3),
         )
+        tr = p.get("trace")
+        if tr is None:
+            self._admit(spec, p.get("arg_deps", []))
+            return
+        # sampled submit: the admit span covers dep registration, quota
+        # admission, and any synchronous dispatch pass it triggers
+        spec.trace = (tr[0], tr[1])
+        t0 = time.monotonic()
         self._admit(spec, p.get("arg_deps", []))
+        self._emit_runtime_span(
+            "hub.admit", "admit", spec.trace, t0, time.monotonic(),
+            task_id=spec.task_id.hex(),
+        )
 
     def _admit(self, spec: TaskSpec, deps: List[bytes]):
         pending = 0
@@ -2290,11 +2465,15 @@ class Hub:
         # timestamps for the timeline; the t_* monotonic twins are what
         # durations (queue wait, run time) are computed from — wall
         # deltas step with NTP (graftlint GL008 guards the distinction)
-        self._task_event(
+        ev = self._task_event(
             spec.task_id, name=spec.fn_id or (spec.method or ""),
             state="PENDING_ARGS" if pending else "PENDING_SCHEDULING",
             submitted_at=time.time(), t_submit=time.monotonic(),
         )
+        if spec.trace is not None:
+            # the trace id rides the task event so flight-recorder
+            # entries (retry/fail/preempt) and the timeline cross-link
+            ev["trace_id"] = spec.trace[0]
         if pending == 0:
             self._enqueue_runnable(spec)
 
@@ -2688,15 +2867,33 @@ class Hub:
         t0 = ev.get("t_queued") or ev.get("t_submit")
         if t0 is not None:
             self._bm_observe(self._bm_placement, now_mono - t0)
+        dispatch_span = None
+        if spec.trace is not None:
+            # the queue-wait span: admit (or the latest retry's
+            # re-queue) -> this dispatch; worker-side spans parent
+            # under its id so the trace reads submit -> queue -> exec
+            dispatch_span = self._emit_runtime_span(
+                "hub.sched", "queue_wait", spec.trace,
+                t0 if t0 is not None else now_mono, now_mono,
+                task_id=spec.task_id.hex(), worker_id=worker.worker_id,
+            )
+            if (not worker.spawn_span_done and worker.spawned_t
+                    and worker.connected_t
+                    and (t0 is None or worker.connected_t >= t0)):
+                # this dispatch waited on the worker's process spawn:
+                # charge the spawn window to the trace (once per worker)
+                worker.spawn_span_done = True
+                self._emit_runtime_span(
+                    "hub.worker_spawn", "spawn", spec.trace,
+                    worker.spawned_t, worker.connected_t,
+                    parent=dispatch_span, worker_id=worker.worker_id,
+                )
         fn_blob = None
         if spec.fn_id not in worker.seen_fns:
             fn_blob = self.functions.get(spec.fn_id)
             worker.seen_fns.add(spec.fn_id)
         msg = P.EXEC_ACTOR_CREATE if spec.is_actor_create else P.EXEC_TASK
-        self._send(
-            worker.conn,
-            msg,
-            {
+        exec_payload = {
                 "task_id": spec.task_id,
                 "fn_id": spec.fn_id,
                 "fn_blob": fn_blob,
@@ -2717,8 +2914,12 @@ class Hub:
                              "_restarted", "placement_group",
                              "tenant", "priority", "job_id")
                 },
-            },
-        )
+        }
+        if dispatch_span is not None:
+            # worker spans (arg fetch / execute / result store) parent
+            # under the dispatch span; nested submits inherit the trace
+            exec_payload["trace"] = (spec.trace[0], dispatch_span)
+        self._send(worker.conn, msg, exec_payload)
 
     def _worker_pythonpath(self) -> str:
         # Propagate the driver's import paths so workers can import ray_tpu
@@ -2745,6 +2946,7 @@ class Hub:
             self.workers[wid] = WorkerEntry(
                 worker_id=wid, state="starting", node_id=node.node_id,
                 runtime_env_hash=renv_hash, spawned_for_actor=for_actor,
+                spawned_t=time.monotonic(),
             )
             env = dict(
                 self.worker_env,
@@ -2776,6 +2978,7 @@ class Hub:
         self.workers[wid] = WorkerEntry(
             worker_id=wid, proc=proc, state="starting", node_id=node.node_id,
             runtime_env_hash=renv_hash, spawned_for_actor=for_actor,
+            spawned_t=time.monotonic(),
         )
 
     def _reap_workers(self):
@@ -2844,6 +3047,7 @@ class Hub:
         wid = self.conn_to_worker.get(conn)
         worker = self.workers.get(wid) if wid else None
         spec = self.tasks.pop(p["task_id"], None)
+        ispec = None  # actor-call spec (lives in actor.inflight, not tasks)
         if worker is not None and worker.state == "busy":
             worker.state = "idle"
             worker.current_task = None
@@ -2857,11 +3061,27 @@ class Hub:
         elif worker is not None and worker.actor_id:
             actor = self.actors.get(worker.actor_id)
             if actor is not None:
-                actor.inflight.pop(p["task_id"], None)
+                ispec = actor.inflight.pop(p["task_id"], None)
+        tr = None
+        for s in (spec, ispec):
+            if s is not None and s.trace is not None:
+                tr = s.trace
+                break
         node_id = worker.node_id if worker is not None else "node0"
         if self._maybe_retry_app_error(spec, p["returns"]):
             self._dispatch()
             return
+        t_done0 = 0.0
+        if tr is not None:
+            # the returns become ready below; readiness pushes to
+            # subscribed waiters stitch in through this map (past the
+            # retry check — a retried task's returns never materialize)
+            traced = self._traced_oids
+            for oid, _k, _pl, _s in p["returns"]:
+                traced[oid] = tr
+            while len(traced) > 4096:  # FIFO bound (untraced push = ok)
+                traced.pop(next(iter(traced)))
+            t_done0 = time.monotonic()
         if spec is not None:
             # final completion: the quota admission charge comes back
             # (retries above keep it — the task is still in the system)
@@ -2895,9 +3115,17 @@ class Hub:
             self._record_event(
                 "task_failed", task_id=p["task_id"].hex(),
                 name=ev.get("name", ""),
+                **({"trace_id": ev["trace_id"]} if "trace_id" in ev else {}),
             )
         for oid, kind, payload, size in p["returns"]:
             self._object_ready(oid, kind, payload, size, node_id=node_id)
+        if tr is not None:
+            # completion handling: return registration + readiness
+            # fan-out (get/wait waiters, pushes) for this task
+            self._emit_runtime_span(
+                "hub.complete", "complete", tr, t_done0, time.monotonic(),
+                task_id=p["task_id"].hex(),
+            )
         self._dispatch()
 
     def _maybe_retry_app_error(self, spec, returns) -> bool:
@@ -2944,7 +3172,7 @@ class Hub:
         self._bm_task_retry["value"] += 1
         self._record_event(
             "task_retry", task_id=spec.task_id.hex(), reason="app_error",
-            retries_left=spec.retries_left,
+            retries_left=spec.retries_left, **self._trace_fields(spec),
         )
         self._enqueue_runnable(spec)
         return True
@@ -3010,6 +3238,7 @@ class Hub:
         self._record_event(
             "task_give_up", task_id=spec.task_id.hex(),
             name=spec.fn_id or (spec.method or ""), error=str(err)[:200],
+            **self._trace_fields(spec),
         )
         self.tasks.pop(spec.task_id, None)
         self.fairsched.settle(spec.task_id)
@@ -3122,6 +3351,9 @@ class Hub:
             actor_id=p["actor_id"],
             method=p["method"],
         )
+        tr = p.get("trace")
+        if tr is not None:
+            spec.trace = (tr[0], tr[1])
         if actor is None or actor.state == "dead":
             from ..exceptions import ActorDiedError
 
@@ -3142,11 +3374,13 @@ class Hub:
                 self.dep_waiters.setdefault(dep, []).append(spec)
         spec.deps_remaining = pending
         spec.options["_actor_call"] = True
-        self._task_event(
+        ev = self._task_event(
             spec.task_id, name=spec.method or "",
             state="PENDING_ARGS" if pending else "PENDING_ACTOR",
             submitted_at=time.time(), t_submit=time.monotonic(),
         )
+        if spec.trace is not None:
+            ev["trace_id"] = spec.trace[0]
         if pending:
             self.tasks[spec.task_id] = spec
             return
@@ -3164,30 +3398,39 @@ class Hub:
             actor.pending_calls.append(spec)
             return
         actor.inflight[spec.task_id] = spec
-        self._task_event(
+        now_mono = time.monotonic()
+        ev = self._task_event(
             spec.task_id, name=spec.method or "", state="RUNNING",
-            started_at=time.time(), t_scheduled=time.monotonic(),
+            started_at=time.time(), t_scheduled=now_mono,
             worker_id=worker.worker_id,
             node_id=worker.node_id, actor_id=actor.actor_id.hex(),
         )
-        self._send(
-            worker.conn,
-            P.EXEC_ACTOR_TASK,
-            {
-                "task_id": spec.task_id,
-                "actor_id": actor.actor_id,
-                "method": spec.method,
-                "args_kind": spec.args_kind,
-                "args_payload": spec.args_payload,
-                "return_ids": spec.return_ids,
-                "options": {
-                    k: v for k, v in spec.options.items()
-                    if k in ("streaming",
-                             "_generator_backpressure_num_objects",
-                             "tenant", "priority", "job_id")
-                },
+        exec_payload = {
+            "task_id": spec.task_id,
+            "actor_id": actor.actor_id,
+            "method": spec.method,
+            "args_kind": spec.args_kind,
+            "args_payload": spec.args_payload,
+            "return_ids": spec.return_ids,
+            "options": {
+                k: v for k, v in spec.options.items()
+                if k in ("streaming",
+                         "_generator_backpressure_num_objects",
+                         "tenant", "priority", "job_id")
             },
-        )
+        }
+        if spec.trace is not None:
+            # actor calls have no runnable-queue phase; the queue_wait
+            # span covers submit-arrival -> forward (dep waits and
+            # pending_calls parking included)
+            t0 = ev.get("t_submit")
+            dispatch_span = self._emit_runtime_span(
+                "hub.actor_route", "queue_wait", spec.trace,
+                t0 if t0 is not None else now_mono, now_mono,
+                task_id=spec.task_id.hex(), method=spec.method or "",
+            )
+            exec_payload["trace"] = (spec.trace[0], dispatch_span)
+        self._send(worker.conn, P.EXEC_ACTOR_TASK, exec_payload)
 
     def _drain_actor_queue_with_error(self, actor: ActorEntry):
         from ..exceptions import ActorDiedError
@@ -3440,6 +3683,7 @@ class Hub:
                 self._record_event(
                     "task_retry", task_id=spec.task_id.hex(),
                     reason="preempted", retries_left=spec.retries_left,
+                    **self._trace_fields(spec),
                 )
                 self._task_event(spec.task_id, state="PENDING_RETRY")
                 self._enqueue_runnable(spec)
@@ -3449,6 +3693,7 @@ class Hub:
                 self._record_event(
                     "task_retry", task_id=spec.task_id.hex(),
                     reason="worker_died", retries_left=spec.retries_left,
+                    **self._trace_fields(spec),
                 )
                 self._enqueue_runnable(spec)
             else:
@@ -3987,7 +4232,7 @@ class Hub:
                 tenant=spec.options.get("tenant") or "default",
                 priority=self.fairsched.priority_of(spec.options),
                 by_pg=entry.pg_id.hex(), by_priority=pri,
-                by_tenant=entry.tenant,
+                by_tenant=entry.tenant, **self._trace_fields(spec),
             )
             spec.options["_preempted"] = True
             w.preempted = True
@@ -4104,6 +4349,26 @@ class Hub:
             items = list(self.task_events)
         elif kind == "events":
             items = list(self.events)
+        elif kind == "traces":
+            tid = p.get("trace_id")
+            if tid:
+                # one trace's raw spans (the CLI/dashboard run the
+                # critical-path analyzer client-side on these)
+                items = [dict(s) for s in self._trace_index.get(tid, ())]
+            else:
+                # running summaries (maintained in _record_span): the
+                # overview never rescans every stored span dict
+                for summ in self._trace_summaries.values():
+                    items.append({
+                        "trace_id": summ["trace_id"],
+                        "n_spans": summ["n_spans"],
+                        "start": summ["start"],
+                        # anchored-monotonic stamps (util/tracing
+                        # wall_at), so the difference IS a duration
+                        "duration_s": summ["end"] - summ["start"],
+                        "root": summ["root"],
+                        "processes": len(summ["procs"]),
+                    })
         elif kind == "metrics":
             self._merge_shard_metrics()
             for m in self.metrics.values():

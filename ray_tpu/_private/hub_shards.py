@@ -198,12 +198,18 @@ class ReactorShard(threading.Thread):
     """
 
     def __init__(self, idx: int, state_ring: ShardRing, drain_budget: int,
-                 listener=None):
+                 listener=None, trace_on: bool = False):
         super().__init__(daemon=True, name=f"ray-tpu-hub-shard-{idx}")
         self.idx = idx
         self.stats = ShardStats()
         self._state_ring = state_ring
         self._drain_budget = drain_budget
+        # runtime tracing live in this session? If so, stamp traced
+        # inbound messages with the decode time so the state plane can
+        # attribute ring-wait latency (it emits the span — this thread
+        # only annotates the payload it already owns, GL010-clean).
+        # False (sampling off) keeps the drain loop byte-identical.
+        self._trace_on = trace_on
         self._listener = listener  # shard 0 only
         self._accept_seq = 0
         self.peers: List["ReactorShard"] = []  # set by the hub before start
@@ -381,6 +387,8 @@ class ReactorShard(threading.Thread):
             while True:
                 blob = conn.recv_bytes()
                 msg_type, payload = loads_frame(blob)
+                if self._trace_on:
+                    self._stamp_trace(msg_type, payload)
                 # the dispatch table tags the message with its owning
                 # state service; "batch" frames stay intact (tag None —
                 # the state plane routes the inner messages, and the
@@ -399,6 +407,20 @@ class ReactorShard(threading.Thread):
         except Exception:
             log_exc(f"hub shard {self.idx} reactor error (dropping conn)")
             self._drop_conn(conn)
+
+    @staticmethod
+    def _stamp_trace(msg_type: str, payload) -> None:
+        """Annotate traced messages with this shard's decode time so
+        the state plane can emit the ring-wait span (hub._ring_wait_span
+        pops the stamp). Runs only with tracing live; touches nothing
+        but the payload this shard just decoded."""
+        now = time.monotonic()
+        if msg_type == "batch":
+            for _mt, pl in payload:
+                if type(pl) is dict and "trace" in pl:
+                    pl["_ring_t"] = now
+        elif type(payload) is dict and "trace" in payload:
+            payload["_ring_t"] = now
 
     def _flush_outbound(self) -> None:
         for conn, msgs in self.outbound.drain():

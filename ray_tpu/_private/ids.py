@@ -43,6 +43,13 @@ def _pooled_id_bytes() -> bytes:
     return buf[pos:pos + _ID_LEN]
 
 
+def span_id_hex() -> str:
+    """16-hex-char tracing span/trace id from the same pooled entropy
+    (util/tracing.py): span open is a hot path when runtime sampling is
+    on, and a uuid.uuid4() per span costs an os.urandom syscall each."""
+    return _pooled_id_bytes()[:8].hex()
+
+
 class BaseID:
     __slots__ = ("_bytes", "_hash")
 

@@ -56,6 +56,17 @@ REGISTER_JOB = "register_job"  # driver/job -> hub: scheduling identity
 TASK_DONE = "task_done"
 ACTOR_READY = "actor_ready"
 
+# any process -> hub: one finished tracing span (util/tracing.py — user
+# spans and the runtime's own stage spans share this message; the hub
+# indexes them per trace_id for list_state("traces")). Distributed
+# trace CONTEXT does not get its own message: a sampled request carries
+# an optional "trace": (trace_id, parent_span_id) field inside the
+# SUBMIT_TASK / SUBMIT_ACTOR_TASK / GET / PUT payload, and the hub
+# forwards (trace_id, its-dispatch-span-id) in EXEC_* payloads so
+# worker-side spans and nested submits stitch into the same trace.
+# Absent the field (sampling off, the default) every path is untouched.
+SPAN_RECORD = "span_record"
+
 # streaming generators (reference: _raylet.pyx:280 ObjectRefGenerator)
 STREAM_YIELD = "stream_yield"    # worker -> hub: one yielded value
 STREAM_END = "stream_end"        # worker -> hub: generator exhausted/raised

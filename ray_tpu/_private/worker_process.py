@@ -27,6 +27,7 @@ import asyncio
 import os
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -34,6 +35,83 @@ from typing import Any, Dict, Optional
 from . import protocol as P
 from .client import CoreClient
 from .serialization import dumps_inline, loads_function, loads_inline
+
+
+class _ExecTrace:
+    """Runtime spans for one traced task execution (the exec payload
+    carried a "trace" field — sampling decided at the CLIENT; this
+    class never runs for untraced tasks). Collects monotonic stamps
+    around the three worker stages (arg fetch, execute, result store),
+    holds the ambient tracing context during the function body so
+    nested submits and user spans stitch into the trace, and ships the
+    finished spans through the worker's existing hub connection."""
+
+    __slots__ = ("client", "trace_id", "parent", "exec_id", "t", "_tok")
+
+    def __init__(self, client, trace):
+        from ..util import tracing as _t
+
+        self.client = client
+        self.trace_id, self.parent = trace[0], trace[1]
+        self.exec_id = _t.new_span_id()  # parent for nested work
+        self.t: Dict[str, float] = {"start": time.monotonic()}
+        self._tok = None
+
+    def stamp(self, key: str) -> None:
+        self.t[key] = time.monotonic()
+
+    def enter_exec(self) -> None:
+        from ..util import tracing as _t
+
+        self.stamp("exec0")
+        self._tok = _t.push_context((self.trace_id, self.exec_id))
+
+    def exit_exec(self) -> None:
+        from ..util import tracing as _t
+
+        if self._tok is not None:
+            _t.pop_context(self._tok)
+            self._tok = None
+        self.stamp("exec1")
+
+    def emit(self, name: str, error: Optional[str] = None,
+             **extra) -> None:
+        from ..util import tracing as _t
+
+        t = self.t
+        recs = []
+        if "args0" in t and "args1" in t:
+            recs.append(_t.make_runtime_record(
+                "worker.arg_fetch", "arg_fetch", self.trace_id,
+                self.parent, t["args0"], t["args1"],
+            ))
+        if "exec0" in t:
+            attrs = {"name": name, **extra}
+            if error is not None:
+                attrs["error"] = error
+            recs.append(_t.make_runtime_record(
+                "worker.execute", "execute", self.trace_id, self.parent,
+                t["exec0"], t.get("exec1", time.monotonic()),
+                span_id=self.exec_id, attrs=attrs,
+            ))
+        elif error is not None:
+            # failed before the body ran (fn fetch / arg decode): the
+            # error span still lands so the trace shows WHERE it died
+            recs.append(_t.make_runtime_record(
+                "worker.execute", "execute", self.trace_id, self.parent,
+                t["start"], time.monotonic(), span_id=self.exec_id,
+                attrs={"name": name, "error": error},
+            ))
+        if "store0" in t and "store1" in t:
+            recs.append(_t.make_runtime_record(
+                "worker.result_store", "result_store", self.trace_id,
+                self.parent, t["store0"], t["store1"],
+            ))
+        try:
+            for rec in recs:
+                self.client.send_async(P.SPAN_RECORD, rec)
+        except Exception:
+            pass  # tracing must never fail the task
 
 
 class WorkerRuntime:
@@ -193,16 +271,40 @@ class WorkerRuntime:
         pg = (p.get("options") or {}).get("placement_group")
         _current_pg.set(tuple(pg) if pg else None)
         fn_name = p["fn_id"]
+        tr = p.get("trace")
+        et = _ExecTrace(self.client, tr) if tr is not None else None
         try:
             fn = self._get_fn(p["fn_id"], p.get("fn_blob"))
             fn_name = getattr(fn, "__name__", fn_name)
+            if et is not None:
+                et.stamp("args0")
             args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
-            result = fn(*args, **kwargs)
+            if et is not None:
+                et.stamp("args1")
+                et.enter_exec()
+            try:
+                result = fn(*args, **kwargs)
+            finally:
+                if et is not None:
+                    et.exit_exec()
             if (p.get("options") or {}).get("streaming"):
+                if et is not None:
+                    # the generator body runs lazily inside
+                    # _stream_results; the execute span here covers
+                    # only its construction
+                    et.emit(fn_name, streaming=True)
                 self._stream_results(p, result)
                 return
+            if et is not None:
+                et.stamp("store0")
             returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
+            if et is not None:
+                et.stamp("store1")
+                et.emit(fn_name)
         except (Exception, KeyboardInterrupt):
+            if et is not None:
+                et.exit_exec()
+                et.emit(fn_name, error=sys.exc_info()[0].__name__)
             if (p.get("options") or {}).get("streaming"):
                 # failed before the generator started: the stream (not
                 # return objects) carries the error
@@ -277,6 +379,8 @@ class WorkerRuntime:
         _current_pg.set(getattr(self, "actor_pg", None))
         self._adopt_job_identity(p)
         method_name = p["method"]
+        tr = p.get("trace")
+        et = _ExecTrace(self.client, tr) if tr is not None else None
         try:
             if method_name == "__ray_ready__":
                 result = None
@@ -297,13 +401,32 @@ class WorkerRuntime:
                 result = fn(self.actor_instance, *rest, **kwargs)
             else:
                 method = getattr(self.actor_instance, method_name)
+                if et is not None:
+                    et.stamp("args0")
                 args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
-                result = method(*args, **kwargs)
+                if et is not None:
+                    et.stamp("args1")
+                    et.enter_exec()
+                try:
+                    result = method(*args, **kwargs)
+                finally:
+                    if et is not None:
+                        et.exit_exec()
             if (p.get("options") or {}).get("streaming"):
+                if et is not None:
+                    et.emit(method_name, streaming=True)
                 self._stream_results(p, result)
                 return
+            if et is not None:
+                et.stamp("store0")
             returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
+            if et is not None:
+                et.stamp("store1")
+                et.emit(method_name)
         except Exception:
+            if et is not None:
+                et.exit_exec()
+                et.emit(method_name, error=sys.exc_info()[0].__name__)
             if (p.get("options") or {}).get("streaming"):
                 self._stream_fail(p, method_name)
                 return
@@ -362,11 +485,30 @@ class WorkerRuntime:
             loop = self._ensure_aio_loop()
 
             async def run():
+                tr = p.get("trace")
+                et = _ExecTrace(self.client, tr) if tr is not None else None
                 try:
+                    if et is not None:
+                        et.stamp("args0")
                     args, kwargs = self._decode_args(p["args_kind"], p["args_payload"])
-                    result = await method(self.actor_instance, *args, **kwargs)
+                    if et is not None:
+                        et.stamp("args1")
+                        et.enter_exec()
+                    try:
+                        result = await method(self.actor_instance, *args, **kwargs)
+                    finally:
+                        if et is not None:
+                            et.exit_exec()
+                    if et is not None:
+                        et.stamp("store0")
                     returns = self._store_returns(p["return_ids"], result, len(p["return_ids"]))
+                    if et is not None:
+                        et.stamp("store1")
+                        et.emit(p["method"])
                 except Exception:
+                    if et is not None:
+                        et.exit_exec()
+                        et.emit(p["method"], error=sys.exc_info()[0].__name__)
                     returns = self._error_returns(p["return_ids"], p["method"])
                 self._send_done({"task_id": p["task_id"], "returns": returns})
 

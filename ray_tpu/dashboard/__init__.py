@@ -12,6 +12,9 @@ chrome-trace timeline, and job submission/inspection:
                                  service message counts (hub_shards.py)
     GET  /api/timeline           chrome://tracing JSON
     GET  /api/events             flight-recorder runtime events
+    GET  /api/traces             sampled distributed traces (summaries)
+    GET  /api/traces/{trace_id}  one trace: raw spans + critical-path
+                                 breakdown (util/tracing.analyze_trace)
     GET  /metrics                Prometheus text (user + ray_tpu_* builtin)
     GET  /api/jobs               scheduler view: {tenants (usage vs
                                  quota), jobs (fairsched registry),
@@ -73,6 +76,7 @@ class Dashboard:
             allowed = {
                 "nodes", "actors", "tasks", "workers", "objects",
                 "placement_groups", "events", "tenants", "shards",
+                "traces",
             }
             if kind not in allowed:
                 raise web.HTTPNotFound(text=f"unknown kind {kind}")
@@ -80,6 +84,19 @@ class Dashboard:
 
         async def timeline(request):
             return web.json_response(self._client().list_state("timeline"))
+
+        async def trace_detail(request):
+            # one trace's raw spans + the critical-path breakdown
+            from ray_tpu.util.tracing import analyze_trace
+
+            spans = self._client().list_state(
+                "traces", trace_id=request.match_info["trace_id"]
+            )
+            if not spans:
+                raise web.HTTPNotFound(text="unknown or evicted trace")
+            return web.json_response(
+                {"spans": spans, "critical_path": analyze_trace(spans)}
+            )
 
         async def data_stats(request):
             import json as _json
@@ -166,6 +183,7 @@ class Dashboard:
         app.router.add_post("/api/jobs", jobs_submit)
         app.router.add_get("/api/jobs/{job_id}", job_status)
         app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
+        app.router.add_get("/api/traces/{trace_id}", trace_detail)
         app.router.add_get("/api/{kind}", list_kind)
         app.router.add_get("/metrics", metrics)
 

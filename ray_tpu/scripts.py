@@ -13,6 +13,8 @@ external CLI framework.
     python -m ray_tpu list actors
     python -m ray_tpu jobs                            # tenants vs quota
     python -m ray_tpu summary tasks
+    python -m ray_tpu trace                           # sampled traces
+    python -m ray_tpu trace <trace_id>                # critical path
     python -m ray_tpu timeline --output /tmp/tl.json
     python -m ray_tpu memory
     python -m ray_tpu job submit -- python train.py
@@ -221,6 +223,7 @@ _LIST_COLUMNS = {
     "shards": ["shard", "service", "conns", "accepted", "wakeups",
                "frames_sent", "drain_saturated", "backpressure",
                "processed"],
+    "traces": ["trace_id", "root", "n_spans", "duration_s", "processes"],
 }
 
 
@@ -273,6 +276,70 @@ def cmd_events(args) -> None:
             "detail": detail[:120],
         })
     _print_table(rows, ["seq", "time", "kind", "detail"])
+
+
+def cmd_trace(args) -> None:
+    """Distributed runtime traces (util/tracing.py). Without an id:
+    list sampled traces. With one: the span table + the critical-path
+    breakdown (which stage the time went to)."""
+    from ray_tpu.util import state as state_api
+    from ray_tpu.util.tracing import analyze_trace
+
+    _connect(args)
+    if not args.trace_id:
+        rows = state_api.list_traces()
+        if args.format == "json":
+            print(json.dumps(rows, indent=2, default=str))
+            return
+        _print_table(
+            [
+                {
+                    "trace_id": r["trace_id"],
+                    "root": r.get("root", ""),
+                    "spans": r.get("n_spans", 0),
+                    "duration_ms": f"{1000 * r.get('duration_s', 0):.1f}",
+                    "processes": r.get("processes", 0),
+                }
+                for r in rows
+            ],
+            ["trace_id", "root", "spans", "duration_ms", "processes"],
+        )
+        return
+    spans = state_api.get_trace(args.trace_id)
+    if not spans:
+        raise SystemExit(f"no trace {args.trace_id!r} (evicted, or never "
+                         "sampled — set RAY_TPU_TRACE_SAMPLE/RAY_TPU_TRACING)")
+    analysis = analyze_trace(spans)
+    if args.format == "json":
+        print(json.dumps({"analysis": analysis, "spans": spans},
+                         indent=2, default=str))
+        return
+    t0 = min(s["start"] for s in spans)
+    _print_table(
+        [
+            {
+                "at_ms": f"{1000 * (s['start'] - t0):.2f}",
+                "dur_ms": f"{1000 * (s['end'] - s['start']):.2f}",
+                "name": s.get("name", ""),
+                "stage": (s.get("attrs") or {}).get("stage", ""),
+                "where": f"{s.get('node_id', '')}/pid={s.get('pid', '')}",
+                "span": s.get("span_id", ""),
+                "parent": s.get("parent_id") or "",
+            }
+            for s in sorted(spans, key=lambda s: s["start"])
+        ],
+        ["at_ms", "dur_ms", "name", "stage", "where", "span", "parent"],
+    )
+    print(f"\nend-to-end: {1000 * analysis['end_to_end_s']:.2f} ms over "
+          f"{len(analysis['processes'])} processes "
+          f"({', '.join(analysis['processes'])})")
+    print("critical path:")
+    for stage, d in analysis["stages"].items():
+        print(f"  {stage:<14} {1000 * d['dur_s']:>9.2f} ms  "
+              f"{100 * d['share']:5.1f}%")
+    print(f"  {'(untracked)':<14} {1000 * analysis['untracked_s']:>9.2f} ms")
+    if analysis["dominant_stage"]:
+        print(f"dominant stage: {analysis['dominant_stage']}")
 
 
 def cmd_jobs(args) -> None:
@@ -474,7 +541,8 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument(
         "kind",
         choices=["actors", "tasks", "workers", "nodes", "objects",
-                 "placement_groups", "pgs", "jobs", "tenants", "shards"],
+                 "placement_groups", "pgs", "jobs", "tenants", "shards",
+                 "traces"],
     )
     sp.add_argument("--format", choices=["table", "json"], default="table")
     add_address(sp)
@@ -499,6 +567,15 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--format", choices=["table", "json"], default="table")
     add_address(sp)
     sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser(
+        "trace", help="distributed runtime traces: list, or one trace's "
+                      "spans + critical-path breakdown"
+    )
+    sp.add_argument("trace_id", nargs="?", default=None)
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_trace)
 
     sp = sub.add_parser("timeline", help="dump chrome://tracing timeline")
     sp.add_argument("--output", default=None)

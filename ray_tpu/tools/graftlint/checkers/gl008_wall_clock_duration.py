@@ -11,8 +11,12 @@ time, monotonic twins feed every subtraction.
 The checker flags a subtraction (``a - b``) where either operand is
 wall-derived — a direct ``time.time()`` call, or a local name whose
 assignment contains one (including ``x = ev.get("t") or time.time()``)
-— scoped to files under ``_private/``: user-facing code (tracing
-spans, usage timestamps) legitimately carries wall timestamps.
+— scoped to files under ``_private/`` PLUS ``ray_tpu/util/tracing.py``:
+tracing is runtime infrastructure whose span durations feed the
+critical-path analyzer (it anchors wall time once per process and
+derives every interval from monotonic stamps — this rule keeps a
+wall-delta duration from regressing in). Other user-facing code
+(usage timestamps, display stamps) legitimately carries wall time.
 
 Exception: an operand derived from file mtimes (``os.path.getmtime``,
 ``os.stat``/``os.fstat``, ``.st_mtime``) exempts the subtraction —
@@ -99,7 +103,7 @@ def _matches(node: ast.AST, names: Set[str], contains, ctx) -> bool:
 @register("GL008", "wall-clock-duration")
 def check(ctx: FileContext) -> List[Finding]:
     norm = "/" + ctx.path.replace(os.sep, "/")
-    if "/_private/" not in norm:
+    if "/_private/" not in norm and not norm.endswith("/util/tracing.py"):
         return []
     out: List[Finding] = []
     quals = qualname_map(ctx.tree)

@@ -60,6 +60,27 @@ def list_tenants(filters: Optional[list] = None) -> List[dict]:
     return _apply_filters(_client().list_state("tenants"), filters)
 
 
+def list_traces(filters: Optional[list] = None) -> List[dict]:
+    """Sampled distributed traces (util/tracing.py runtime spans): one
+    summary row per trace_id — span count, start, duration, root span
+    name, number of distinct processes. Use get_trace() for spans."""
+    return _apply_filters(_client().list_state("traces"), filters)
+
+
+def get_trace(trace_id: str) -> List[dict]:
+    """All recorded spans of one trace, raw (feed these through
+    ray_tpu.util.tracing.analyze_trace for the critical-path view)."""
+    return _client().list_state("traces", trace_id=trace_id)
+
+
+def summarize_trace(trace_id: str) -> Dict[str, Any]:
+    """Critical-path breakdown of one trace: per-stage durations,
+    dominant stage, untracked remainder (util/tracing.analyze_trace)."""
+    from ray_tpu.util.tracing import analyze_trace
+
+    return analyze_trace(get_trace(trace_id))
+
+
 def _apply_filters(items: List[dict], filters: Optional[list]) -> List[dict]:
     """filters: [(key, "=" | "!=", value), ...] (reference filter shape)."""
     if not filters:

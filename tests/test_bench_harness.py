@@ -51,6 +51,11 @@ def test_smoke_run_reports_every_baseline_metric(tmp_path):
 
     missing = set(BASELINES) - set(data["metrics"])
     assert not missing, f"BASELINES metrics missing from report: {missing}"
+    # tracing_overhead schema: the on/off throughput ratio with runtime
+    # tracing head-sampled at 1.0 (evidence row, never gated)
+    overhead = data["metrics"]["tracing_overhead"]
+    assert overhead["unit"] == "ratio"
+    assert overhead["value"] > 0
     for name, rec in data["metrics"].items():
         assert rec["value"] > 0, f"{name} reported a non-positive value"
     # every stdout metric line is one JSON object (the scrapeable form)

@@ -715,8 +715,11 @@ def test_gl008_clean_wall_timestamp_without_delta():
     assert codes_of(src, path=_PRIV) == []
 
 
-def test_gl008_only_applies_to_private():
-    # user-facing spans/timelines legitimately carry wall timestamps
+def test_gl008_scope_covers_private_and_tracing():
+    # runtime core AND util/tracing.py (span durations feed the
+    # critical-path analyzer — a wall-delta duration there regresses
+    # the very thing the tracer exists to measure); other user-facing
+    # code legitimately carries wall timestamps
     src = """
     import time
 
@@ -724,8 +727,9 @@ def test_gl008_only_applies_to_private():
         t0 = time.time()
         return time.time() - t0
     """
-    assert codes_of(src, path="ray_tpu/util/tracing.py") == []
+    assert "GL008" in codes_of(src, path="ray_tpu/util/tracing.py")
     assert "GL008" in codes_of(src, path=_PRIV)
+    assert codes_of(src, path="ray_tpu/util/metrics.py") == []
 
 
 # ---------------------------------------------------------- infrastructure

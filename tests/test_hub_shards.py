@@ -332,6 +332,12 @@ def test_shard_fatal_tears_the_session_down(monkeypatch):
     hub = worker._hub
     try:
         assert hub.n_shards == 2
+        # the rings are created on the state-plane thread after start:
+        # on a loaded 1-core box the thread may not have run yet
+        deadline = time.monotonic() + 10
+        while not hub._shard_rings and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert hub._shard_rings, "state plane never built its rings"
         # inject the event a dying shard pushes from its except path
         hub._shard_rings[0].push(
             (None, None, SHARD_EVENT, {"kind": "shard_fatal", "shard": 1})
