@@ -72,6 +72,35 @@ class CoreClient:
         )
         self.store = ShmObjectStore(session_dir)
         self.conn = connect_hub(hub_addr)
+        # fault injection (chaos.py): this process's scope of the
+        # cluster chaos plan — outbound message drop/delay/dup. None
+        # (the default) keeps the send paths at one attribute load.
+        from . import chaos as _chaos_mod
+
+        self._chaos = _chaos_mod.engine_for(
+            "worker" if role == "worker" else "client"
+        )
+        # retransmit backoff knobs from the config table
+        # (request_retry_period_s / request_retry_max_s env or .set()
+        # overrides). Instance attrs shadow the class defaults only on
+        # an explicit non-default override, so tests can still
+        # monkeypatch the class attributes. period <= 0 = retransmit
+        # OFF (requests wait on their first send), matching the repo's
+        # 0-disables convention.
+        from .config import RAY_TPU_CONFIG as _cfg
+        from .config import _DEFAULTS as _cfg_defaults
+
+        try:
+            stock = float(_cfg_defaults["request_retry_period_s"])
+            base = float(_cfg.get("request_retry_period_s", stock))
+            if base != stock:
+                self._RETRY_PERIOD_S = base
+            stock = float(_cfg_defaults["request_retry_max_s"])
+            cap = float(_cfg.get("request_retry_max_s", stock))
+            if cap != stock:
+                self._RETRY_MAX_S = cap
+        except (TypeError, ValueError, KeyError):
+            pass  # malformed override: keep the defaults
         self._send_lock = threading.Lock()
         self._send_buf: List[tuple] = []
         self._buf_evt = threading.Event()
@@ -210,6 +239,17 @@ class CoreClient:
     # this matters because the hub thread shares the driver's GIL; without
     # batching every message pays a GIL handoff (~sys.getswitchinterval()).
     def send(self, msg_type: str, payload: dict) -> None:
+        if self._chaos is not None:
+            # 0 = injected drop (the retransmit layer must recover),
+            # 2 = duplicate delivery (hub dedup/idempotency must hold)
+            n = self._chaos.outbound_send(msg_type)
+            if n == 0:
+                return
+            if n == 2:
+                self._send_one(msg_type, payload)  # the duplicate
+        self._send_one(msg_type, payload)
+
+    def _send_one(self, msg_type: str, payload: dict) -> None:
         with self._send_lock:
             if self._send_buf:
                 buf, self._send_buf = self._send_buf, []
@@ -219,14 +259,24 @@ class CoreClient:
                 self.conn.send_bytes(dumps_frame((msg_type, payload)))
 
     def send_async(self, msg_type: str, payload: dict) -> None:
+        dup = False
+        if self._chaos is not None:
+            k = self._chaos.outbound_send(msg_type)
+            if k == 0:
+                return
+            dup = k == 2
         with self._send_lock:
+            was_empty = not self._send_buf
             self._send_buf.append((msg_type, payload))
-            n = len(self._send_buf)
-            if n >= 128:
+            if dup:
+                # duplicate appended under the SAME acquisition so the
+                # buffer-empty wake below still fires for this batch
+                self._send_buf.append((msg_type, payload))
+            if len(self._send_buf) >= 128:
                 buf, self._send_buf = self._send_buf, []
                 self.conn.send_bytes(dumps_frame(("batch", buf)))
                 return
-        if n == 1:
+        if was_empty:
             self._buf_evt.set()
 
     def flush(self) -> None:
@@ -427,7 +477,25 @@ class CoreClient:
         P.RESOLVE_OBJECT,   # pure read of the location directory
         P.SUBSCRIBE_READY,  # idempotent watcher registration
     }
+    # Retransmit cadence: capped exponential backoff with full jitter
+    # (reference: rpc/retryable_grpc_client.h's exponential backoff —
+    # the previous fixed ~2s re-send turned every hub stall into a
+    # synchronized retransmit storm from the whole client herd, and is
+    # exactly the shape graftlint GL011 now flags). _RETRY_PERIOD_S is
+    # the base delay; doubles per resend up to _RETRY_MAX_S.
     _RETRY_PERIOD_S = 2.0
+    _RETRY_MAX_S = 30.0
+
+    def _retry_delay(self, delay: float,
+                     cap: Optional[float] = None) -> Tuple[float, float]:
+        """(this wait's jittered duration, next backoff step). Full
+        jitter on [base/2, base] keeps the mean cadence near base while
+        desynchronizing retransmit herds. `cap` bounds the growth
+        (default: the retransmit ceiling; _wait_push resyncs cap at 8s
+        so a lost push costs seconds, not the full ceiling)."""
+        if cap is None:
+            cap = self._RETRY_MAX_S
+        return delay * (0.5 + 0.5 * random.random()), min(cap, delay * 2.0)
 
     def request(self, msg_type: str, payload: dict, timeout: Optional[float] = None) -> dict:
         import time as _time
@@ -442,11 +510,14 @@ class CoreClient:
         retryable = msg_type in self._RETRY_SAFE and not (
             msg_type == P.KV_PUT and not payload.get("overwrite", True)
         )
-        if not retryable:
+        if not retryable or self._RETRY_PERIOD_S <= 0:
+            # period <= 0 = retransmit disabled: park on the first send
+            # (a zero base must not degenerate into a busy-spin flood)
             return fut.result(timeout=timeout)
         deadline = None if timeout is None else _time.monotonic() + timeout
+        delay = self._RETRY_PERIOD_S
         while True:
-            remaining = self._RETRY_PERIOD_S
+            remaining, delay = self._retry_delay(delay)
             if deadline is not None:
                 remaining = min(remaining, deadline - _time.monotonic())
                 if remaining <= 0:
@@ -461,7 +532,10 @@ class CoreClient:
             if self._closed:
                 raise ConnectionError("hub connection lost")
             # reply lost or hub slow: retransmit the same req_id (a
-            # duplicate reply finds no pending future and is dropped)
+            # duplicate reply finds no pending future and is dropped;
+            # the hub's _inflight_reqs dedup keeps one parked waiter —
+            # and one traced span — per logical request regardless of
+            # how many resends the backoff schedule produces)
             self.send(msg_type, payload)
 
     # -------------------------------------------------------- runtime tracing
@@ -1048,6 +1122,14 @@ class CoreClient:
         deadline = (
             None if timeout is None else time.monotonic() + timeout
         )
+        # re-subscribe cadence backs off like the request retransmit
+        # path (pushes are the primary wake; the periodic resync only
+        # covers lost pushes) — capped low so a genuinely lost push
+        # costs seconds, not the full retransmit ceiling. The resync
+        # must stay alive even with retransmits disabled (period <= 0):
+        # a lost push with no re-subscribe is a permanent hang.
+        base = self._RETRY_PERIOD_S if self._RETRY_PERIOD_S > 0 else 2.0
+        resync = base
         while True:
             self._ready_evt.clear()
             ready = self._scan_ready(ids, num_returns)
@@ -1085,26 +1167,33 @@ class CoreClient:
                     if len(subscribed) > 131072:
                         subscribed.clear()
                 continue  # re-scan with the reply folded in
-            remaining = self._RETRY_PERIOD_S
+            remaining, backed_off = self._retry_delay(resync, cap=8.0)
             if deadline is not None:
                 remaining = min(remaining, deadline - time.monotonic())
                 if remaining <= 0:
                     return ready
             if not self._ready_evt.wait(remaining):
-                # a full retry period with no push: drop these ids from
+                # a full resync period with no push: drop these ids from
                 # the memo so the next pass re-subscribes — the reply
-                # re-syncs readiness even if pushes were lost (chaos)
+                # re-syncs readiness even if pushes were lost (chaos) —
+                # and back the period off (no fixed-interval retransmit)
+                resync = backed_off
                 with self._obj_cache_lock:
                     self._ready_subscribed.difference_update(ids)
-            elif len(ids) >= 256:
-                # push debounce for BIG waits: completions stream one
-                # push at a time, and on a busy single-core host every
-                # wake of this thread steals the GIL from the hub
-                # thread mid-dispatch (they share this process for
-                # local drivers). One short sleep batches the next few
-                # pushes into a single wake/scan instead of one wake
-                # per completed task; small waits stay latency-exact.
-                time.sleep(0.002)
+            else:
+                # pushes are flowing again: later losses should re-sync
+                # at the base cadence, not the backed-off one
+                resync = base
+                if len(ids) >= 256:
+                    # push debounce for BIG waits: completions stream
+                    # one push at a time, and on a busy single-core
+                    # host every wake of this thread steals the GIL
+                    # from the hub thread mid-dispatch (they share this
+                    # process for local drivers). One short sleep
+                    # batches the next few pushes into a single
+                    # wake/scan instead of one wake per completed task;
+                    # small waits stay latency-exact.
+                    time.sleep(0.002)
 
     def free(self, object_ids: Sequence[ObjectID]) -> None:
         with self._obj_cache_lock:

@@ -41,8 +41,11 @@ _DEFAULTS: Dict[str, Any] = {
     # scheduling / workers
     "worker_reap_period_s": 1.0,
     "max_pending_spawns_per_node": 32,
-    # rpc
+    # rpc: retry-safe requests retransmit with capped exponential
+    # backoff + jitter — period is the base delay (0 = retransmit OFF:
+    # requests park on their first send), max is the backoff ceiling
     "request_retry_period_s": 2.0,
+    "request_retry_max_s": 30.0,
     "client_batch_max": 128,
     # memory monitor (reference: common/memory_monitor.h + raylet
     # worker_killing_policy.cc) — kill the newest worker past the cap
@@ -54,8 +57,23 @@ _DEFAULTS: Dict[str, Any] = {
     "builtin_metrics": True,             # ray_tpu_* runtime self-metrics
     "node_heartbeat_period_s": 2.0,      # per-node gauge cadence; 0 = off
     "flight_recorder_path": "",          # "" = <session_dir>/flight_recorder.json
-    # test hooks
-    "chaos_drop": "",
+    # fault tolerance (reference: num_heartbeats_timeout in
+    # ray_config_def.h — the GCS declares a raylet dead after N missed
+    # heartbeats; here the threshold counts node_heartbeat_period_s
+    # periods, so 15 * 2s = 30s matches the reference default)
+    "node_heartbeat_miss_threshold": 15,  # missed periods -> node death; 0 = off
+    # hung-worker watchdog: every dispatched task gets this execute
+    # deadline unless it carries its own options(timeout_s=...); past
+    # it the worker is SIGKILLed and the task retries per its budget
+    # (a SIGSTOP'd/hung worker never EOFs on its own). 0 = off.
+    "task_timeout_default_s": 0.0,
+    # fault injection: documents RAY_TPU_CHAOS_PLAN (chaos.py grammar;
+    # RAY_TPU_CHAOS_DROP / RAY_TPU_CHAOS_OBJECT_AGENT stay as legacy
+    # aliases). chaos.py reads the ENV directly, not this snapshot:
+    # engines are built in worker/agent/client processes that never
+    # run reload(), and a plan baked into a stale snapshot would
+    # resurrect faults after the env was cleared.
+    "chaos_plan": "",
 }
 
 

@@ -65,23 +65,13 @@ from .serialization import (
     loads_inline,
 )
 
-# Chaos hook for fault-injection tests (reference: src/ray/rpc/rpc_chaos.h:23
-# — env-selected per-method message drop). Set RAY_TPU_CHAOS_DROP to
-# "msg_type:probability" to drop inbound messages of that type.
-def _parse_chaos():
-    spec = os.environ.get("RAY_TPU_CHAOS_DROP", "")
-    out = {}
-    for part in spec.split(","):
-        if ":" in part:
-            mt, prob = part.rsplit(":", 1)
-            try:
-                out[mt] = float(prob)
-            except ValueError:
-                pass
-    return out
-
-
-_CHAOS = _parse_chaos()
+# Fault injection (reference: src/ray/rpc/rpc_chaos.h env-selected
+# per-method failure, grown into a seeded deterministic plan): the hub
+# hosts the "hub" scope of the chaos engine — message drop/delay/dup at
+# the dispatch seam, timed conn/worker faults, node partitions. See
+# chaos.py for the RAY_TPU_CHAOS_PLAN grammar; with no plan the engine
+# is None and every injection point is one attribute load.
+from . import chaos as _chaos_mod
 
 
 @dataclass
@@ -147,6 +137,11 @@ class NodeEntry:
     # ("tcp://host:port" or an AF_UNIX path; "" = agent disabled —
     # transfers to/from this node ride the hub relay)
     object_endpoint: str = ""
+    # monotonic stamp of the last agent heartbeat; the heartbeat-miss
+    # watchdog declares the node dead past the configured threshold
+    # (reference: gcs_node_manager heartbeat timeout). 0 = head node /
+    # never heartbeated.
+    last_heartbeat_t: float = 0.0
 
 
 @dataclass
@@ -200,6 +195,10 @@ class WorkerEntry:
     spawned_t: float = 0.0
     connected_t: float = 0.0
     spawn_span_done: bool = False
+    # dispatch generation: bumped by every _send_exec so a per-task
+    # timeout timer armed for attempt N can never kill attempt N+1 of
+    # the SAME (retried, hence identical) TaskSpec on this worker
+    exec_gen: int = 0
 
 
 @dataclass
@@ -385,7 +384,9 @@ class Hub:
 
         _config_mod.reload()
         self.config = _config_mod.RAY_TPU_CONFIG
-        self._chaos = _parse_chaos()
+        # None (no plan / nothing for the hub scope) = inert fault
+        # plane: _handle/_handle_sharded pay one attribute load
+        self._chaos = _chaos_mod.engine_for("hub")
         self.session_dir = session_dir
         os.makedirs(session_dir, exist_ok=True)
         if tcp:
@@ -430,6 +431,17 @@ class Hub:
         self.runnable: Dict[tuple, deque] = {}
         self.workers: Dict[str, WorkerEntry] = {}
         self.conn_to_worker: Dict[Any, str] = {}
+        # driver/client conns in HELLO order (value = (arrival seq,
+        # monotonic HELLO stamp)): deterministic victim ordering for
+        # chaos conn_kill, pruned on disconnect. The driver conn is
+        # never a victim (killing it is session teardown by design —
+        # driver fate-sharing), and neither is a conn younger than the
+        # grace period below (a kill landing between a client's HELLO
+        # and its first request reply tests the race, not recovery).
+        self.client_conns: Dict[Any, tuple] = {}
+        self._client_conn_seq = itertools.count()
+        # dispatch generation counter for per-task execute timeouts
+        self._exec_seq = itertools.count(1)
         self.actors: Dict[bytes, ActorEntry] = {}
         self.named_actors: Dict[Tuple[str, str], bytes] = {}
         # permanently-dead actor ids, FIFO: beyond the cap the oldest
@@ -477,7 +489,10 @@ class Hub:
         self.timers: List[Tuple[float, int, Any]] = []  # (deadline, seq, callback)
         self._timer_seq = itertools.count()
         self._fetch_seq = itertools.count()
-        self._pending_fetches: Dict[int, Tuple[Any, int]] = {}
+        # fid -> (conn, request payload, node_id); the payload keeps its
+        # req_id/offset/length so a node-death replay preserves chunk
+        # identity
+        self._pending_fetches: Dict[int, Tuple[Any, dict, str]] = {}
         # in-progress chunked client puts: (conn id, name) -> open file
         self._client_puts: Dict[Tuple[int, str], Any] = {}
         self._spawn_wants: Dict[str, int] = {}
@@ -493,7 +508,7 @@ class Hub:
         # ordered dict so the (rare) entries for ids that never
         # materialize can be evicted oldest-first.
         self._released_early: Dict[bytes, bool] = {}
-        self._reconstruct_waiters: Dict[bytes, List[Tuple[Any, int]]] = {}
+        self._reconstruct_waiters: Dict[bytes, List[Tuple[Any, dict]]] = {}
         self._reconstructing: Set[bytes] = set()
         self._ended_streams: deque = deque()  # consumed stream ids, FIFO
         # observability plane (reference: stats/metric.h registry +
@@ -789,6 +804,16 @@ class Hub:
             self._add_timer(
                 self.config.node_heartbeat_period_s, self._head_heartbeat
             )
+            if self.config.node_heartbeat_miss_threshold > 0:
+                self._add_timer(
+                    self.config.node_heartbeat_period_s,
+                    self._check_node_heartbeats,
+                )
+        if self._chaos is not None:
+            # (re-)anchor the schedule clock to the control plane start
+            self._chaos.arm()
+            if self._chaos.timed:
+                self._add_timer(0.05, self._chaos_tick)
 
     def _teardown_runtime(self) -> None:
         """Shared epilogue: stop workers/agents and flush the last
@@ -925,29 +950,49 @@ class Hub:
                         services) -> None:
         """_handle's sharded twin: route one shard-delivered message to
         its state service. Chaos shares _handle's single decision point
-        (outer msg_type only); batch frames fan their inner messages
-        out to each message's owning service, preserving arrival
-        order. The only intended divergence from _handle is the
-        per-service accounting seam (StateService.handle)."""
-        if self._chaos_dropped(msg_type):
-            return  # injected message drop
+        (outer msg_type only, on the state-plane thread — so the seeded
+        decision sequence is identical under both topologies); batch
+        frames fan their inner messages out to each message's owning
+        service, preserving arrival order. The only intended divergence
+        from _handle is the per-service accounting seam
+        (StateService.handle)."""
         trace_on = self._trace_on  # shards only stamp when sampling is on
+        if trace_on:
+            # pop ring stamps BEFORE the chaos seam: the ring crossing
+            # already happened (the span is valid even for a frame chaos
+            # then drops), and a delayed/dup redelivery must not carry a
+            # stale stamp into its handler
+            if msg_type == "batch":
+                for _mt, pl in payload:
+                    if type(pl) is dict and "_ring_t" in pl:
+                        self._ring_wait_span(conn, pl)
+            elif type(payload) is dict and "_ring_t" in payload:
+                self._ring_wait_span(conn, payload)
+        if self._chaos is not None and self._chaos_intercept(
+            conn, msg_type, payload
+        ):
+            return  # injected drop/delay (redelivery is timer-driven)
         if msg_type == "batch":
-            from .hub_shards import SERVICE_OF
-
-            sched = services["scheduler"]
-            objs = services["objects"]
             for mt, pl in payload:
-                if trace_on and type(pl) is dict and "_ring_t" in pl:
-                    self._ring_wait_span(conn, pl)
-                svc = objs if SERVICE_OF.get(mt) == "objects" else sched
-                svc.handle(conn, mt, pl)
+                self._route_to_service(conn, mt, pl)
             return
-        if trace_on and type(payload) is dict and "_ring_t" in payload:
-            self._ring_wait_span(conn, payload)
         services.get(service, services["scheduler"]).handle(
             conn, msg_type, payload
         )
+
+    def _route_to_service(self, conn, msg_type, payload) -> None:
+        """Route one (non-batch) message to its owning StateService by
+        SERVICE_OF — the ONE ownership rule batch fan-out and chaos
+        redelivery share. (The non-batch ring path routes by the
+        shard's service tag instead, which the shard derived from the
+        same table.)"""
+        from .hub_shards import SERVICE_OF
+
+        svc = self.state_services[
+            "objects" if SERVICE_OF.get(msg_type) == "objects"
+            else "scheduler"
+        ]
+        svc.handle(conn, msg_type, payload)
 
     def _ring_wait_span(self, conn, payload: dict) -> None:
         """A traced message crossed a shard's SPSC ring: the owning
@@ -1048,6 +1093,7 @@ class Hub:
         node = self.nodes.get(p.get("node_id", ""))
         if node is None or not node.alive:
             return
+        node.last_heartbeat_t = time.monotonic()
         self._node_stat_gauges(
             node.node_id,
             rss_bytes=float(p.get("rss_bytes", 0.0)),
@@ -1287,26 +1333,235 @@ class Hub:
         os.replace(tmp, path)
         return path
 
-    # -------------------------------------------------------------- dispatch
-    def _chaos_dropped(self, msg_type: str) -> bool:
-        """The ONE chaos-drop decision both topologies share: the
-        probability is checked against the frame's OUTER msg_type
-        (batch frames drop whole, never per inner message)."""
-        if not self._chaos:
-            return False
-        import random
+    # ------------------------------------------------- fault injection
+    # (chaos.py engine, hub scope). All methods below are reached only
+    # behind `if self._chaos is not None` — the inert default costs one
+    # attribute load per inbound frame.
+    def _chaos_trace(self, msg_type: str, payload) -> dict:
+        """trace_id cross-link for a fault event, when the victim
+        message is traced — a fault then shows up inside its victim's
+        trace via the PR 8 events<->trace join."""
+        if msg_type != "batch" and type(payload) is dict:
+            tr = payload.get("trace")
+            if tr is not None:
+                return {"trace_id": tr[0]}
+        return {}
 
-        prob = self._chaos.get(msg_type)
-        if prob and random.random() < prob:
-            self._record_event("chaos_drop", msg_type=msg_type)
+    def _chaos_intercept(self, conn, msg_type: str, payload) -> bool:
+        """The ONE message-fault decision point both topologies share:
+        drop/delay/dup are decided against the frame's OUTER msg_type
+        (batch frames fault whole, never per inner message), and a
+        partitioned node's conns are blackholed wholesale. Returns True
+        when the frame must NOT be dispatched now."""
+        eng = self._chaos
+        if eng.partitions:
+            nid = self.agent_conns.get(conn)
+            if nid is None:
+                wid = self.conn_to_worker.get(conn)
+                if wid is not None:
+                    w = self.workers.get(wid)
+                    nid = w.node_id if w is not None else None
+            if nid is not None and eng.partition_active(nid):
+                eng.record("partition_drop", node_id=nid, msg_type=msg_type)
+                self._record_event(
+                    "chaos_partition_drop", node_id=nid, msg_type=msg_type,
+                )
+                return True
+        act = eng.message_action(msg_type)
+        if act is None:
+            return False
+        kind = act[0]
+        if kind == "drop":
+            self._record_event(
+                "chaos_drop", msg_type=msg_type,
+                **self._chaos_trace(msg_type, payload),
+            )
             return True
+        if kind == "delay":
+            self._record_event(
+                "chaos_delay", msg_type=msg_type, delay_s=round(act[1], 6),
+                **self._chaos_trace(msg_type, payload),
+            )
+            self._add_timer(
+                act[1],
+                lambda c=conn, mt=msg_type, pl=payload:
+                    self._dispatch_after_chaos(c, mt, pl),
+            )
+            return True
+        # dup: deliver the duplicate first, then fall through to the
+        # normal dispatch — exercises the retransmit-dedup and
+        # idempotent-handler paths exactly like a replayed frame
+        self._record_event(
+            "chaos_dup", msg_type=msg_type,
+            **self._chaos_trace(msg_type, payload),
+        )
+        self._dispatch_after_chaos(conn, msg_type, payload)
         return False
 
+    def _dispatch_after_chaos(self, conn, msg_type: str, payload) -> None:
+        """Chaos-exempt redelivery (the delayed copy / the duplicate):
+        a second engine pass would re-draw and could delay forever.
+        Sharded mode routes through the owning StateService so the
+        per-service accounting seam counts redelivered frames exactly
+        like first deliveries (timers run on the state-plane thread,
+        the services' single owner)."""
+        if getattr(conn, "closed", False):
+            # the peer disconnected inside the delay window (both
+            # topologies close the conn in _safe_disconnect): replaying
+            # now would re-register the dead conn in stateful handlers
+            # (_on_hello inserting it into client_conns/workers), and
+            # no second CONN_LOST ever prunes it
+            return
+        try:
+            if self._shards:
+                if msg_type == "batch":
+                    for mt, pl in payload:
+                        self._route_to_service(conn, mt, pl)
+                else:
+                    self._route_to_service(conn, msg_type, payload)
+            elif msg_type == "batch":
+                for mt, pl in payload:
+                    self._dispatch_msg(conn, mt, pl)
+            else:
+                self._dispatch_msg(conn, msg_type, payload)
+        except Exception:
+            log_exc(f"hub handler error on {msg_type} (chaos redelivery)")
+
+    def _chaos_tick(self) -> None:
+        """Execute due timed faults (conn_kill / worker_kill /
+        worker_hang) against the live cluster tables; a fault with no
+        eligible victim yet is deferred, not dropped — the schedule is
+        the plan's, the victims are whatever the cluster offers."""
+        eng = self._chaos
+        for fault in list(eng.due_faults()):
+            try:
+                self._apply_timed_fault(eng, fault)
+            except Exception:
+                log_exc(f"chaos fault {fault.kind} failed")
+                eng.consume(fault, fault.count - fault.fired)
+        if eng.timed:
+            self._add_timer(0.05, self._chaos_tick)
+
+    def _apply_timed_fault(self, eng, fault) -> None:
+        if fault.kind == "conn_kill":
+            if fault.arg == "worker":
+                victims = [
+                    w.conn
+                    for _, w in sorted(self.workers.items())
+                    if w.conn is not None
+                ]
+            else:
+                # established (post-grace) non-driver clients, oldest
+                # first: a kill inside the HELLO->first-reply window
+                # would test the connect race, not recovery
+                now = time.monotonic()
+                victims = [
+                    c for c, (_seq, t0) in sorted(
+                        self.client_conns.items(), key=lambda kv: kv[1][0]
+                    )
+                    if c is not self.driver_conn and now - t0 >= 0.5
+                ]
+            if not victims:
+                eng.defer(fault)
+                return
+            eng.record("conn_kill", role=fault.arg)
+            self._record_event("chaos_conn_kill", role=fault.arg)
+            eng.consume(fault)
+            self._expel_conn(victims[0])
+            return
+        # worker_kill / worker_hang: busy plain-task workers first (a
+        # fault plane exists to hit in-flight work), then actors, then
+        # idle pool members — ordered by worker id within each tier
+        hang = fault.kind == "worker_hang"
+        _tier = {"busy": 0, "actor": 1}
+
+        def _reachable(w) -> bool:
+            # hub-local proc handle, or a live agent that holds one
+            # (remote faults ride P.KILL_WORKER with a sig field)
+            if w.proc is not None:
+                return True
+            node = self.nodes.get(w.node_id)
+            return (node is not None and node.alive
+                    and node.agent_conn is not None)
+
+        candidates = sorted(
+            (w for w in self.workers.values()
+             if w.conn is not None and _reachable(w)
+             and w.state in ("busy", "actor", "idle")),
+            key=lambda w: (_tier.get(w.state, 2), w.worker_id),
+        )
+        want = fault.count - fault.fired
+        if not candidates:
+            eng.defer(fault)
+            return
+        for w in candidates[:want]:
+            spec = w.current_task
+            fields = {
+                "worker_id": w.worker_id, "node_id": w.node_id,
+                **self._trace_fields(spec),
+            }
+            if spec is not None:
+                fields["task_id"] = spec.task_id.hex()
+            eng.record(fault.kind, worker_id=w.worker_id)
+            self._record_event(f"chaos_{fault.kind}", **fields)
+            eng.consume(fault)
+            # "stop" = SIGSTOP: the process stalls mid-instruction but
+            # its socket stays open — only the hung-worker watchdog /
+            # per-task timeout_s can recover this. No _expel_conn here:
+            # chaos leaves discovery to the runtime's own recovery.
+            self._deliver_worker_signal(w, "stop" if hang else "kill")
+        if fault.fired < fault.count:
+            eng.defer(fault)
+
+    def _expel_conn(self, conn) -> None:
+        """Forcibly drop one peer connection (chaos conn_kill, or the
+        heartbeat-miss watchdog evicting a partitioned node's agent).
+        The peer sees EOF; registries clean up through the normal
+        disconnect path."""
+        if self._shards:
+            idx = self._conn_shard.get(conn)
+            if idx is not None:
+                # the owning shard must do the unregister (its selector,
+                # its thread); cleanup comes back as CONN_LOST
+                self._shards[idx].expel(conn)
+                return
+        self._safe_disconnect(conn)
+
+    def _check_node_heartbeats(self) -> None:
+        """Heartbeat-miss node death (reference: GcsNodeManager's
+        heartbeat timeout): an agent whose heartbeats stopped — network
+        partition, frozen host — is declared dead after the configured
+        number of missed periods; its conn is expelled so the normal
+        node-death path (task retry elsewhere, reconstruction,
+        __node_down__ invalidation) runs. Conn EOF remains the fast
+        path; this catches the silent half-open case."""
+        period = self.config.node_heartbeat_period_s
+        limit = self.config.node_heartbeat_miss_threshold * period
+        now = time.monotonic()
+        for node in list(self.nodes.values()):
+            if node.agent_conn is None or not node.alive:
+                continue
+            if node.last_heartbeat_t and now - node.last_heartbeat_t > limit:
+                missed = (now - node.last_heartbeat_t) / period
+                sys.stderr.write(
+                    f"[ray_tpu] node {node.node_id}: no heartbeat for "
+                    f"{missed:.1f} periods; declaring it dead\n"
+                )
+                self._record_event(
+                    "node_heartbeat_miss", node_id=node.node_id,
+                    missed_periods=round(missed, 1),
+                )
+                self._expel_conn(node.agent_conn)
+        self._add_timer(period, self._check_node_heartbeats)
+
+    # -------------------------------------------------------------- dispatch
     def _handle(self, conn, msg_type: str, payload):
         """Table dispatch against the {msg_type: bound_method} map built
         in __init__ (no per-message reflection — GL007)."""
-        if self._chaos_dropped(msg_type):
-            return  # injected message drop
+        if self._chaos is not None and self._chaos_intercept(
+            conn, msg_type, payload
+        ):
+            return  # injected drop/delay (redelivery is timer-driven)
         if msg_type == "batch":
             for mt, pl in payload:
                 self._dispatch_msg(conn, mt, pl)
@@ -1381,8 +1636,16 @@ class Hub:
             self._dispatch()
         elif p["role"] == "driver":
             self.driver_conn = conn
-        # role == "client": a remote driver (Ray Client parity) — its
-        # disconnect must NOT tear the session down
+            self.client_conns[conn] = (
+                next(self._client_conn_seq), time.monotonic(),
+            )
+        elif p["role"] == "client":
+            # a remote driver (Ray Client parity) — its disconnect must
+            # NOT tear the session down. Tracked (HELLO order) so chaos
+            # conn_kill has a deterministic victim ordering.
+            self.client_conns[conn] = (
+                next(self._client_conn_seq), time.monotonic(),
+            )
 
     def _on_register_node(self, conn, p):
         node = NodeEntry(
@@ -1401,6 +1664,7 @@ class Hub:
             agent_conn=conn,
             store_cap=float(p.get("store_cap") or 0),
             object_endpoint=p.get("object_endpoint") or "",
+            last_heartbeat_t=time.monotonic(),
         )
         # dead nodes stay as tombstones for introspection/lineage
         self.nodes[node.node_id] = node  # graftlint: disable=GL009
@@ -1472,9 +1736,12 @@ class Hub:
         if kind == P.VAL_SHM and size > 0:
             self._account_segment(oid, e)
         self._reconstructing.discard(oid)
-        # serve fetches that were parked on reconstruction
-        for wconn, req_id in self._reconstruct_waiters.pop(oid, []):
-            self._on_fetch_object(wconn, {"object_id": oid, "req_id": req_id})
+        # serve fetches that were parked on reconstruction: replay the
+        # ORIGINAL request payload — a chunked fetch keeps its
+        # offset/length, so the reply slots into the client's
+        # reassembly exactly where the pre-death chunk would have
+        for wconn, req in self._reconstruct_waiters.pop(oid, []):
+            self._on_fetch_object(wconn, req)
         # unblock task dependencies
         for spec in self.dep_waiters.pop(oid, []):
             spec.deps_remaining -= 1
@@ -1940,7 +2207,26 @@ class Hub:
             # first relay chunk of a failed direct transfer: record it
             # (once per transfer — only offset 0 carries the flag)
             self._record_fallback(p["object_id"], p["fallback"], "fetch")
-        e = self.objects.get(p["object_id"])
+        oid = p["object_id"]
+        if oid in self._reconstructing:
+            # a fetch racing an in-flight lineage rerun (the backoff
+            # retransmit of the very request that triggered it, or a
+            # second consumer): the entry is marked not-ready for the
+            # whole reconstruction window, so falling through to the
+            # "no such segment" reply would turn a recoverable wait
+            # into ObjectLostError at the client. Park it beside the
+            # fetch that started the rerun (idempotent per req_id).
+            waiters = self._reconstruct_waiters.setdefault(oid, [])
+            if not any(
+                w[0] is conn and w[1]["req_id"] == p["req_id"]
+                for w in waiters
+            ):
+                waiters.append((conn, self._park_fetch_payload(p)))
+                # same give-up bound as the kick-off fetch: a rerun
+                # that never completes must fail these waiters too
+                self._add_timer(60.0, lambda oid=oid: self._reconstruct_give_up(oid))
+            return
+        e = self.objects.get(oid)
         if e is None or not e.ready or e.kind != P.VAL_SHM:
             self._reply(conn, p["req_id"], data=None, error="no such segment")
             return
@@ -1950,21 +2236,12 @@ class Hub:
             # the producing task (reference: ObjectRecoveryManager)
             spec = self._lineage.get(p["object_id"])
             if spec is not None:
-                oid = p["object_id"]
                 self._reconstruct_waiters.setdefault(oid, []).append(
-                    (conn, p["req_id"])
+                    (conn, self._park_fetch_payload(p))
                 )
-
-                def give_up(oid=oid):
-                    # rerun unplaceable (resources gone) or stuck: fail
-                    # the parked fetches instead of hanging them forever
-                    for wconn, req_id in self._reconstruct_waiters.pop(oid, []):
-                        self._reply(wconn, req_id, data=None,
-                                    error="object lost: reconstruction "
-                                          "timed out")
-                    self._reconstructing.discard(oid)
-
-                self._add_timer(60.0, give_up)
+                self._add_timer(
+                    60.0, lambda oid=oid: self._reconstruct_give_up(oid)
+                )
                 if p["object_id"] not in self._reconstructing:
                     self._reconstructing.update(spec.return_ids)
                     for roid in spec.return_ids:
@@ -2027,7 +2304,9 @@ class Hub:
             self._reply(conn, p["req_id"], data=data, total=total)
             return
         fid = next(self._fetch_seq)
-        self._pending_fetches[fid] = (conn, p["req_id"], node.node_id)
+        self._pending_fetches[fid] = (
+            conn, self._park_fetch_payload(p), node.node_id
+        )
         self._send(node.agent_conn, P.OBJ_READ,
                    {"fetch_id": fid, "name": e.payload,
                     "offset": offset, "length": length})
@@ -2036,7 +2315,7 @@ class Hub:
         waiter = self._pending_fetches.pop(p["fetch_id"], None)
         if waiter is None:
             return
-        self._reply(waiter[0], waiter[1], data=p.get("data"),
+        self._reply(waiter[0], waiter[1]["req_id"], data=p.get("data"),
                     error=p.get("error"), total=p.get("total"))
 
     # ----- chunked client puts (shm-less client -> head-node store;
@@ -2101,14 +2380,38 @@ class Hub:
                 p["object_id"], P.VAL_SHM, name, size, node_id="node0"
             )
 
+    @staticmethod
+    def _park_fetch_payload(p: dict) -> dict:
+        """The request payload to replay after reconstruction: keep
+        req_id/offset/length (chunk identity), drop the fallback flag —
+        the original delivery already recorded the transfer fallback."""
+        req = dict(p)
+        req.pop("fallback", None)
+        return req
+
+    def _reconstruct_give_up(self, oid: bytes) -> None:
+        """Reconstruction watchdog: a rerun left unplaceable (resources
+        gone) or stuck past the 60s budget fails its parked fetches
+        instead of hanging them forever."""
+        for wconn, req in self._reconstruct_waiters.pop(oid, []):
+            self._reply(wconn, req["req_id"], data=None,
+                        error="object lost: reconstruction timed out")
+        self._reconstructing.discard(oid)
+
     def _fail_fetches_for_node(self, node_id: str):
-        """A fetch whose producer node died would otherwise hang its
-        requester forever (clients wait with timeout=None)."""
+        """Relay fetches in flight to a node that just died: replay each
+        one through _on_fetch_object, which now sees the dead node and
+        either parks it on a lineage rerun (reconstruction) or fails it
+        with an explicit error — never a silent hang (clients wait with
+        timeout=None)."""
         stale = [fid for fid, w in self._pending_fetches.items() if w[2] == node_id]
         for fid in stale:
-            conn, req_id, _ = self._pending_fetches.pop(fid)
-            self._reply(conn, req_id, data=None,
-                        error=f"object lost: node {node_id} died mid-fetch")
+            conn, req, _ = self._pending_fetches.pop(fid)
+            if req["object_id"] in self._lineage:
+                self._on_fetch_object(conn, req)
+            else:
+                self._reply(conn, req["req_id"], data=None,
+                            error=f"object lost: node {node_id} died mid-fetch")
 
     # ----- streaming generators
     def _stream(self, task_id: bytes) -> StreamEntry:
@@ -2424,6 +2727,13 @@ class Hub:
 
     # ----- tasks
     def _on_submit_task(self, conn, p):
+        if p["task_id"] in self._task_event_index:
+            # duplicate delivery (chaos dup / a replayed frame): the
+            # task is already pending, running, or done — admitting a
+            # second TaskSpec would double-run it and double-charge
+            # quota. Ids are client-generated and unique, so a re-seen
+            # id is always a duplicate, never a new task.
+            return
         spec = TaskSpec(
             task_id=p["task_id"],
             fn_id=p["fn_id"],
@@ -2920,6 +3230,74 @@ class Hub:
             # under the dispatch span; nested submits inherit the trace
             exec_payload["trace"] = (spec.trace[0], dispatch_span)
         self._send(worker.conn, msg, exec_payload)
+        # per-task execute deadline: options(timeout_s=...) wins, else
+        # the cluster-wide hung-worker watchdog default (0 = off). A
+        # one-shot timer per dispatch — the default path arms nothing.
+        timeout_s = spec.options.get("timeout_s") or (
+            self.config.task_timeout_default_s
+        )
+        if timeout_s and timeout_s > 0:
+            worker.exec_gen = gen = next(self._exec_seq)
+            self._add_timer(
+                float(timeout_s),
+                lambda w=worker, s=spec, g=gen, t=float(timeout_s):
+                    self._check_exec_timeout(w, s, g, t),
+            )
+
+    def _check_exec_timeout(self, worker: WorkerEntry, spec: TaskSpec,
+                            gen: int, timeout_s: float) -> None:
+        """The task dispatched at generation `gen` is still running on
+        `worker` past its deadline: SIGKILL the worker (a hung —
+        SIGSTOP'd, deadlocked, livelocked — process ignores the
+        cooperative KILL and never EOFs on its own) and let the normal
+        worker-death path retry the task against its crash-retry budget
+        (a timeout IS a crash, unlike a preemption — the task may hang
+        every time), or fail it with TaskTimeoutError once exhausted."""
+        if (
+            self.workers.get(worker.worker_id) is not worker
+            or worker.exec_gen != gen
+            or worker.current_task is not spec
+            or worker.state not in ("busy", "actor")
+        ):
+            return  # that dispatch already finished (or was retried)
+        spec.options["_timed_out"] = timeout_s
+        self._record_event(
+            "task_timeout", task_id=spec.task_id.hex(),
+            worker_id=worker.worker_id, timeout_s=timeout_s,
+            **self._trace_fields(spec),
+        )
+        self._force_kill_worker(worker)
+
+    def _deliver_worker_signal(self, w: WorkerEntry, sig: str) -> None:
+        """Route "kill"/"stop" to a worker's process wherever its proc
+        handle lives: hub-local Popen, or its node agent via
+        P.KILL_WORKER's sig field. "kill" is SIGKILL, never SIGTERM —
+        a SIGSTOP'd or wedged worker queues SIGTERM forever."""
+        import signal as _signal
+
+        try:
+            if w.proc is not None:
+                if sig == "stop":
+                    os.kill(w.proc.pid, _signal.SIGSTOP)
+                else:
+                    w.proc.kill()
+                return
+            node = self.nodes.get(w.node_id)
+            if node is not None and node.agent_conn is not None:
+                self._send(node.agent_conn, P.KILL_WORKER,
+                           {"worker_id": w.worker_id, "sig": sig})
+        except (OSError, ProcessLookupError):
+            pass
+
+    def _force_kill_worker(self, w: WorkerEntry) -> None:
+        """SIGKILL the stalled target (watchdog/timeout recovery path —
+        chaos worker_hang sends SIGSTOP, so only SIGKILL terminates)."""
+        self._deliver_worker_signal(w, "kill")
+        # drop the conn ourselves: the EOF from the kill arrives
+        # eventually, but expelling now makes recovery latency the
+        # timer's, not the kernel's
+        if w.conn is not None:
+            self._expel_conn(w.conn)
 
     def _worker_pythonpath(self) -> str:
         # Propagate the driver's import paths so workers can import ray_tpu
@@ -3048,7 +3426,17 @@ class Hub:
         worker = self.workers.get(wid) if wid else None
         spec = self.tasks.pop(p["task_id"], None)
         ispec = None  # actor-call spec (lives in actor.inflight, not tasks)
-        if worker is not None and worker.state == "busy":
+        if (
+            worker is not None and worker.state == "busy"
+            and worker.current_task is not None
+            and worker.current_task.task_id == p["task_id"]
+        ):
+            # identity-gated, not state-gated: a DUPLICATE task_done
+            # (chaos dup / replayed frame) whose first copy already
+            # freed this worker — and whose _dispatch may have put a
+            # NEW task on it — must not reset the worker under that
+            # task (which would double-book it and disarm its
+            # exec-timeout guard)
             worker.state = "idle"
             worker.current_task = None
             worker.tpu_chips = ()  # chips stay pinned to the worker (affinity)
@@ -3255,6 +3643,12 @@ class Hub:
 
     # ----- actors
     def _on_create_actor(self, conn, p):
+        if p["actor_id"] in self.actors:
+            # duplicate delivery: the entry exists — re-admitting the
+            # creation spec would spawn a second worker for the same
+            # actor id. (Named duplicates from DIFFERENT clients carry
+            # different actor_ids and still hit the name check below.)
+            return
         options = p["options"]
         entry = ActorEntry(
             actor_id=p["actor_id"],
@@ -3339,6 +3733,8 @@ class Hub:
         self._dispatch()
 
     def _on_submit_actor_task(self, conn, p):
+        if p["task_id"] in self._task_event_index:
+            return  # duplicate delivery: the call is already in flight
         actor = self.actors.get(p["actor_id"])
         spec = TaskSpec(
             task_id=p["task_id"],
@@ -3431,6 +3827,41 @@ class Hub:
             )
             exec_payload["trace"] = (spec.trace[0], dispatch_span)
         self._send(worker.conn, P.EXEC_ACTOR_TASK, exec_payload)
+        # execute deadline for actor calls too (method.options(timeout_s=)
+        # or the cluster-wide watchdog): a hung actor worker never EOFs,
+        # and without this every queued call on it wedges forever. The
+        # kill takes the whole worker — under max_concurrency that is
+        # the deadline's documented blast radius — and the normal death
+        # path fails in-flight calls with ActorDiedError and restarts
+        # the actor per its budget.
+        timeout_s = spec.options.get("timeout_s") or (
+            self.config.task_timeout_default_s
+        )
+        if timeout_s and timeout_s > 0:
+            self._add_timer(
+                float(timeout_s),
+                lambda a=actor, w=worker, s=spec, t=float(timeout_s):
+                    self._check_actor_exec_timeout(a, w, s, t),
+            )
+
+    def _check_actor_exec_timeout(self, actor: ActorEntry, worker: WorkerEntry,
+                                  spec: TaskSpec, timeout_s: float) -> None:
+        """The actor call is still in flight on the same incarnation
+        past its deadline: SIGKILL the (possibly hung) worker; the
+        worker-death path surfaces ActorDiedError to in-flight callers
+        and restarts the actor per max_restarts."""
+        if (
+            actor.inflight.get(spec.task_id) is not spec
+            or actor.worker_id != worker.worker_id
+            or self.workers.get(worker.worker_id) is not worker
+        ):
+            return  # completed, or a different incarnation by now
+        self._record_event(
+            "task_timeout", task_id=spec.task_id.hex(),
+            worker_id=worker.worker_id, actor_id=actor.actor_id.hex(),
+            timeout_s=timeout_s, **self._trace_fields(spec),
+        )
+        self._force_kill_worker(worker)
 
     def _drain_actor_queue_with_error(self, actor: ActorEntry):
         from ..exceptions import ActorDiedError
@@ -3579,6 +4010,7 @@ class Hub:
                     pass
                 if not watchers:
                     del self._ready_watchers[oid]
+        self.client_conns.pop(conn, None)
         self.fairsched.drop_conn(cid)
         # prune per-tenant gauges for tenants the drop removed (the
         # charge/settle sites are gated on live tenants and would
@@ -3687,6 +4119,30 @@ class Hub:
                 )
                 self._task_event(spec.task_id, state="PENDING_RETRY")
                 self._enqueue_runnable(spec)
+            elif spec.options.get("_timed_out"):
+                # execute deadline (options(timeout_s=) / hung-worker
+                # watchdog): the watchdog killed the worker. Retry
+                # against the crash budget; past it, the error names
+                # the timeout rather than a generic crash.
+                timeout_s = spec.options.pop("_timed_out")
+                if spec.retries_left > 0:
+                    spec.retries_left -= 1
+                    self._bm_task_retry["value"] += 1
+                    self._record_event(
+                        "task_retry", task_id=spec.task_id.hex(),
+                        reason="timeout", retries_left=spec.retries_left,
+                        **self._trace_fields(spec),
+                    )
+                    self._task_event(spec.task_id, state="PENDING_RETRY")
+                    self._enqueue_runnable(spec)
+                else:
+                    from ..exceptions import TaskTimeoutError
+
+                    self._fail_task(spec, TaskTimeoutError(
+                        f"task exceeded its execute deadline of "
+                        f"{timeout_s}s and its retry budget; the stalled "
+                        f"worker was killed"
+                    ))
             elif spec.retries_left > 0:
                 spec.retries_left -= 1
                 self._bm_task_retry["value"] += 1
@@ -4504,6 +4960,25 @@ class Hub:
                     "shape": dict(key), "count": count,
                     "pending_quota": True,
                 })
+        elif kind == "chaos":
+            # fault-injection plane: the active plan + trigger counts
+            # first, then recent fault events from the flight recorder
+            # (chaos_* kinds plus the recovery events they provoke)
+            if self._chaos is not None:
+                snap = self._chaos.snapshot()
+                items.append({
+                    "plan": snap["plan"], "seed": snap["seed"],
+                    "armed": snap["armed"],
+                    "elapsed_s": snap["elapsed_s"],
+                    "counts": snap["counts"],
+                    "pending_timed": snap["pending_timed"],
+                    "partitions": snap["partitions"],
+                })
+            fault_kinds = ("task_timeout", "node_heartbeat_miss")
+            for ev in self.events:
+                k = ev.get("kind", "")
+                if k.startswith("chaos_") or k in fault_kinds:
+                    items.append(dict(ev))
         elif kind == "jobs":
             items = self.fairsched.job_table()
         elif kind == "tenants":

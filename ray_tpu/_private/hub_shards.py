@@ -240,6 +240,14 @@ class ReactorShard(threading.Thread):
         shard, or by the hub for bookkeeping-free test injection)."""
         self._inbox.push(("adopt", conn))
 
+    def expel(self, conn) -> None:
+        """State plane -> this shard: forcibly drop one owned conn
+        (chaos conn_kill / heartbeat-miss eviction). The unregister must
+        happen on THIS thread (it owns the selector); cleanup flows back
+        as CONN_LOST exactly like an organic EOF, and the state plane
+        closes the socket after its registry sweep."""
+        self._inbox.push(("expel", conn))
+
     def post(self, conn, msgs: list) -> None:
         """State plane -> this shard: one per-peer batch to encode+send."""
         self.outbound.push((conn, msgs))
@@ -343,6 +351,8 @@ class ReactorShard(threading.Thread):
         for op, conn in self._inbox.drain():
             if op == "adopt":
                 self._register(sel, conn)
+            elif op == "expel" and conn in self._conn_routes:
+                self._drop_conn(conn)
 
     def _register(self, sel, conn) -> None:
         try:

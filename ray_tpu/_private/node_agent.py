@@ -73,6 +73,14 @@ class NodeAgent:
                 )
             except OSError:
                 pass  # relay-only node
+        # fault injection (chaos.py, "agent" scope): drop/delay/dup on
+        # this agent's outbound messages — e.g.
+        # drop:agent.node_heartbeat@1 is heartbeat suppression, the
+        # cheap half of a partition (the hub's heartbeat-miss watchdog
+        # must then declare this node dead). None = inert.
+        from .chaos import engine_for
+
+        self._chaos = engine_for("agent")
         self.conn = connect_hub(self.hub_addr)
 
         resources = {"CPU": float(os.environ.get("RAY_TPU_NUM_CPUS", "1"))}
@@ -110,7 +118,14 @@ class NodeAgent:
         )
 
     def _send(self, msg_type: str, payload: dict) -> None:
-        self.conn.send_bytes(dumps_frame((msg_type, payload)))
+        n = 1
+        if self._chaos is not None:
+            n = self._chaos.outbound_send(msg_type)  # 0 drop / 1 / 2 dup
+            if n == 0:
+                return
+        blob = dumps_frame((msg_type, payload))
+        for _ in range(n):
+            self.conn.send_bytes(blob)
 
     # ------------------------------------------------------------------
     def run(self) -> None:
@@ -122,10 +137,15 @@ class NodeAgent:
         from .config import RAY_TPU_CONFIG
 
         hb_period = float(RAY_TPU_CONFIG.node_heartbeat_period_s)
+        # the poll timeout bounds the heartbeat jitter: at the default
+        # 2s period a 1s poll is fine, but a sub-second period (tests,
+        # aggressive heartbeat-miss thresholds) must not be floored to
+        # the 1s poll or the hub's miss watchdog sees phantom silence
+        poll_t = min(1.0, hb_period) if hb_period > 0 else 1.0
         last_hb = 0.0
         try:
             while True:
-                if self.conn.poll(1.0):
+                if self.conn.poll(poll_t):
                     # bounded burst drain (the hub reactor's shape): a
                     # spawn storm from the hub — now potentially fanned
                     # out by several reactor shards at once — lands as
@@ -245,6 +265,21 @@ class NodeAgent:
                 )
             except OSError:
                 pass
+        elif msg_type == P.KILL_WORKER:
+            # hub-side execute timeout / hung-worker watchdog / chaos
+            # worker faults. sig="stop" is chaos worker_hang (SIGSTOP:
+            # stall, socket stays open); default is SIGKILL, not
+            # terminate — a SIGSTOP'd or wedged worker queues SIGTERM
+            # forever (the reap loop reports the exit)
+            proc = self.children.get(p.get("worker_id", ""))
+            if proc is not None:
+                try:
+                    if p.get("sig") == "stop":
+                        proc.send_signal(signal.SIGSTOP)
+                    else:
+                        proc.kill()
+                except Exception:
+                    pass
         elif msg_type == P.KILL:
             raise EOFError  # unified teardown path
 

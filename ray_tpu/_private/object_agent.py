@@ -27,9 +27,11 @@ agent can die mid-stream without losing data — only bandwidth.
 Reference analogue: src/ray/object_manager/object_manager.h (push/pull
 between plasma stores over its own RPC service, never through the GCS).
 
-Chaos hook: RAY_TPU_CHAOS_OBJECT_AGENT="close_after:N" closes every
+Chaos hook: a ``close_after:N`` directive in the RAY_TPU_CHAOS_PLAN
+(legacy alias: RAY_TPU_CHAOS_OBJECT_AGENT="close_after:N") closes every
 connection after serving N data chunks — the tier-1 harness for
-"serving peer dies mid-transfer" (tests/test_object_plane.py).
+"serving peer dies mid-transfer" (tests/test_object_plane.py). The
+agent hosts the "object_agent" scope of the chaos engine (chaos.py).
 """
 
 from __future__ import annotations
@@ -43,17 +45,6 @@ from .debug import log_exc
 from .serialization import dumps_frame, loads_frame
 
 CHUNK = 8 * 1024 * 1024
-
-
-def _parse_chaos() -> int:
-    """close_after:N -> N served data chunks per connection; 0 = off."""
-    spec = os.environ.get("RAY_TPU_CHAOS_OBJECT_AGENT", "")
-    if spec.startswith("close_after:"):
-        try:
-            return max(1, int(spec.split(":", 1)[1]))
-        except ValueError:
-            return 0
-    return 0
 
 
 class ObjectAgent:
@@ -88,7 +79,11 @@ class ObjectAgent:
         self.bytes_served = 0
         self.bytes_received = 0
         self.transfers = 0
-        self._chaos_close_after = _parse_chaos()
+        from . import chaos as _chaos_mod
+
+        eng = _chaos_mod.engine_for("object_agent")
+        self._chaos = eng
+        self._chaos_close_after = eng.close_after if eng is not None else 0
         self._closed = False
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="object-agent-accept"
@@ -135,12 +130,14 @@ class ObjectAgent:
                 if msg_type == "obj_get":
                     chunks_left = self._serve_get(conn, p, chunks_left)
                     if chunks_left == 0:
+                        self._chaos.record("close_after")
                         return  # chaos: simulated mid-stream death
                 elif msg_type == "obj_put":
                     put_state = self._serve_put(conn, p, put_state)
                     if chunks_left > 0:
                         chunks_left -= 1
                         if chunks_left == 0:
+                            self._chaos.record("close_after")
                             return  # chaos: simulated mid-stream death
                 else:
                     conn.send_bytes(dumps_frame(

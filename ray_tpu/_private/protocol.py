@@ -79,6 +79,10 @@ REGISTER_NODE = "register_node"
 NODE_HEARTBEAT = "node_heartbeat"  # agent -> hub: cpu/rss/worker gauges
 SPAWN_WORKER = "spawn_worker"      # hub -> agent: fork a worker process
 WORKER_EXITED = "worker_exited"    # agent -> hub: child died pre-connect
+KILL_WORKER = "kill_worker"        # hub -> agent: SIGKILL a worker (task
+                                   # timeout / hung-worker watchdog — a
+                                   # stalled process ignores the
+                                   # cooperative KILL message)
 OBJ_READ = "obj_read"              # hub -> agent: read a shm segment
 OBJ_READ_REPLY = "obj_read_reply"  # agent -> hub: segment bytes
 OBJ_UNLINK = "obj_unlink"          # hub -> agent: free a shm segment

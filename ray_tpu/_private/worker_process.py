@@ -261,9 +261,22 @@ class WorkerRuntime:
             (opts.get("job_id"), opts.get("tenant"), priority)
         )
 
+    def _chaos_stall(self) -> None:
+        """Fault injection (chaos.py, "worker" scope): a
+        ``delay:worker.exec@lo-hi`` rule stalls the task body before it
+        runs — an in-worker slow-execute fault that needs no signals
+        (the SIGSTOP-style stall is the hub's worker_hang). Inert (one
+        attribute load) without a plan."""
+        eng = self.client._chaos
+        if eng is not None:
+            act = eng.message_action("exec")
+            if act is not None and act[0] == "delay":
+                time.sleep(act[1])
+
     # ------------------------------------------------------------ execution
     def exec_task(self, p: dict):
         self._adopt_job_identity(p)
+        self._chaos_stall()
         if p.get("tpu_chips"):
             os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in p["tpu_chips"])
         from ..runtime_context import _current_pg
@@ -378,6 +391,7 @@ class WorkerRuntime:
         _current_task_id.set(p.get("task_id"))
         _current_pg.set(getattr(self, "actor_pg", None))
         self._adopt_job_identity(p)
+        self._chaos_stall()
         method_name = p["method"]
         tr = p.get("trace")
         et = _ExecTrace(self.client, tr) if tr is not None else None

@@ -74,6 +74,14 @@ class OutOfMemoryError(RayError):
     """A worker was killed by the memory monitor."""
 
 
+class TaskTimeoutError(RayError):
+    """The task's execute exceeded options(timeout_s=...) (or the
+    cluster-wide hung-worker watchdog deadline) and its retry budget is
+    exhausted. The runtime SIGKILLs the stalled worker — a hung process
+    (e.g. a SIGSTOP'd or deadlocked worker) never EOFs on its own — and
+    retries the task first; this error is the give-up."""
+
+
 # Reference-compatible aliases
 RayTaskError = TaskError
 RayActorError = ActorError

@@ -32,6 +32,7 @@ _TASK_OPTION_KEYS = {
     "max_calls",
     "priority",
     "tenant",
+    "timeout_s",
     "_metadata",
 }
 
@@ -118,6 +119,11 @@ def scheduling_options(opts: Dict[str, Any]) -> Dict[str, Any]:
             out["strategy"] = strategy
     if opts.get("max_retries") is not None:
         out["max_retries"] = opts["max_retries"]
+    if opts.get("timeout_s"):
+        # execute deadline: past it the hub SIGKILLs the (possibly
+        # hung) worker and retries the task against its crash budget,
+        # failing with TaskTimeoutError once exhausted
+        out["timeout_s"] = float(opts["timeout_s"])
     # multi-tenant scheduling (fairsched): per-call priority/tenant
     # override the driver's registered JobConfig (client._stamp_job
     # fills the defaults with setdefault, so explicit values win)

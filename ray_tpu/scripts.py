@@ -15,6 +15,7 @@ external CLI framework.
     python -m ray_tpu summary tasks
     python -m ray_tpu trace                           # sampled traces
     python -m ray_tpu trace <trace_id>                # critical path
+    python -m ray_tpu chaos                           # fault injection
     python -m ray_tpu timeline --output /tmp/tl.json
     python -m ray_tpu memory
     python -m ray_tpu job submit -- python train.py
@@ -342,6 +343,56 @@ def cmd_trace(args) -> None:
         print(f"dominant stage: {analysis['dominant_stage']}")
 
 
+def cmd_chaos(args) -> None:
+    """Fault-injection plane: the active chaos plan, per-fault trigger
+    counts, and recent fault events (chaos.py; RAY_TPU_CHAOS_PLAN)."""
+    from ray_tpu.util import state as state_api
+
+    _connect(args)
+    rows = state_api.list_chaos()
+    if args.format == "json":
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    plan_rows = [r for r in rows if "plan" in r]
+    events = [r for r in rows if "kind" in r]
+    if not plan_rows:
+        print("no chaos plan active (set RAY_TPU_CHAOS_PLAN on the head)")
+    for r in plan_rows:
+        print(f"plan: {r['plan']}")
+        print(f"seed: {r['seed']}  armed: {r['armed']}  "
+              f"elapsed: {r.get('elapsed_s', 0):.1f}s")
+        counts = r.get("counts") or {}
+        if counts:
+            print("trigger counts:")
+            for k in sorted(counts):
+                print(f"  {k:<18} {counts[k]}")
+        pend = r.get("pending_timed") or []
+        if pend:
+            print("pending timed faults:")
+            for f in pend:
+                print(f"  {f['kind']}@{f['at_s']}s "
+                      f"({f['fired']}/{f['count']} fired)")
+        parts = r.get("partitions") or {}
+        if parts:
+            print(f"partitions: {parts}")
+    if events:
+        print("\nrecent fault events:")
+        _print_table(
+            [
+                {
+                    "seq": e.get("seq", ""),
+                    "kind": e.get("kind", ""),
+                    "detail": " ".join(
+                        f"{k}={v}" for k, v in e.items()
+                        if k not in ("seq", "ts", "kind")
+                    )[:100],
+                }
+                for e in events[-30:]
+            ],
+            ["seq", "kind", "detail"],
+        )
+
+
 def cmd_jobs(args) -> None:
     """Multi-tenant scheduler view: per-tenant usage vs quota plus the
     registered job table (fairsched). Quota units are hub resource
@@ -542,7 +593,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "kind",
         choices=["actors", "tasks", "workers", "nodes", "objects",
                  "placement_groups", "pgs", "jobs", "tenants", "shards",
-                 "traces"],
+                 "traces", "chaos"],
     )
     sp.add_argument("--format", choices=["table", "json"], default="table")
     add_address(sp)
@@ -567,6 +618,14 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--format", choices=["table", "json"], default="table")
     add_address(sp)
     sp.set_defaults(fn=cmd_jobs)
+
+    sp = sub.add_parser(
+        "chaos", help="fault-injection plane: active plan, trigger "
+                      "counts, recent fault events"
+    )
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_chaos)
 
     sp = sub.add_parser(
         "trace", help="distributed runtime traces: list, or one trace's "

@@ -13,4 +13,5 @@ from . import (  # noqa: F401
     gl008_wall_clock_duration,
     gl009_unbounded_registry,
     gl010_cross_shard_state,
+    gl011_retry_without_backoff,
 )

@@ -60,6 +60,16 @@ def list_tenants(filters: Optional[list] = None) -> List[dict]:
     return _apply_filters(_client().list_state("tenants"), filters)
 
 
+def list_chaos(filters: Optional[list] = None) -> List[dict]:
+    """Fault-injection plane (chaos.py): the active RAY_TPU_CHAOS_PLAN
+    with per-fault trigger counts (first row, present only when a plan
+    is set), then recent fault events from the flight recorder —
+    chaos_* kinds, plus the recovery events task_timeout and
+    node_heartbeat_miss, which appear whether or not the fault was
+    injected (a real hang or partition lands here too)."""
+    return _apply_filters(_client().list_state("chaos"), filters)
+
+
 def list_traces(filters: Optional[list] = None) -> List[dict]:
     """Sampled distributed traces (util/tracing.py runtime spans): one
     summary row per trace_id — span count, start, duration, root span

@@ -1,10 +1,16 @@
-"""Chaos fault injection: RAY_TPU_CHAOS_DROP drops inbound hub messages
-by type/probability (reference: src/ray/rpc/rpc_chaos.h:23 driving flake
-regression). The client's retransmit layer (idempotent requests resend
-on reply loss — the analogue of the reference's retryable gRPC client)
-must keep every path below correct under heavy drop rates."""
+"""Chaos fault injection (chaos.py): one seeded RAY_TPU_CHAOS_PLAN
+drives message drop/delay/dup, timed conn/worker faults, partitions,
+and mid-stream transfer death (reference: src/ray/rpc/rpc_chaos.h
+driving flake regression; FoundationDB-style seeded schedules for
+reproducibility). The legacy RAY_TPU_CHAOS_DROP env keeps working as an
+alias — the first block of tests below still uses it deliberately. The
+client's retransmit layer (idempotent requests resend with capped
+exponential backoff on reply loss — the analogue of the reference's
+retryable gRPC client) must keep every path below correct under heavy
+drop rates."""
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -92,3 +98,441 @@ def test_pg_ready_survives_drops(chaos_runtime):
     pg = placement_group([{"CPU": 1}])
     assert pg.wait(timeout_seconds=30)
     remove_placement_group(pg)
+
+
+# ------------------------------------------------------ plan grammar units
+
+
+def test_plan_grammar_parses_every_fault_type():
+    from ray_tpu._private.chaos import parse_plan
+
+    p = parse_plan(
+        "seed=7;drop:submit_task@0.05;delay:get@10ms-50ms;"
+        "dup:put@0.2;delay:worker.exec@1s-2s@0.5;drop:client.get@0.3;"
+        "conn_kill:client@t+2s;worker_kill:2@1.5s;worker_hang:1;"
+        "partition:node2@3s-5s;close_after:2"
+    )
+    assert p.seed == 7
+    kinds = [(r.kind, r.scope, r.msg_type) for r in p.rules]
+    assert ("drop", "hub", "submit_task") in kinds
+    assert ("delay", "hub", "get") in kinds
+    assert ("delay", "worker", "exec") in kinds
+    assert ("drop", "client", "get") in kinds
+    delay = next(r for r in p.rules if r.msg_type == "get")
+    assert (delay.lo, delay.hi) == (0.01, 0.05)
+    timed = [(f.kind, f.at, f.count) for f in p.timed]
+    # sorted by fire time; t+2s == 2s; worker_hang defaults to t=1s
+    assert timed == [
+        ("worker_hang", 1.0, 1), ("worker_kill", 1.5, 2),
+        ("conn_kill", 2.0, 1),
+    ]
+    assert p.partitions == {"node2": [(3.0, 5.0)]}
+    assert p.close_after == 2
+
+
+def test_plan_rejects_malformed_directives():
+    from ray_tpu._private.chaos import PlanError, parse_plan
+
+    for bad in ("seed=x", "drop:get@nope", "delay:get", "frobnicate:1",
+                "partition:node1", "conn_kill:hub@1s",
+                "delay:get@5s-1s", "delay:get@1ms-2ms@oops",
+                "drop:worker.exec@0.5", "dup:worker.exec@1"):
+        with pytest.raises(PlanError):
+            parse_plan(bad)
+
+
+def test_legacy_aliases_translate(monkeypatch):
+    from ray_tpu._private import chaos
+
+    monkeypatch.delenv("RAY_TPU_CHAOS_PLAN", raising=False)
+    monkeypatch.setenv("RAY_TPU_CHAOS_DROP", "get:0.4,wait:0.2")
+    monkeypatch.setenv("RAY_TPU_CHAOS_OBJECT_AGENT", "close_after:3")
+    hub_eng = chaos.engine_for("hub")
+    assert hub_eng is not None
+    assert {mt for mt in hub_eng.rules} == {"get", "wait"}
+    agent_eng = chaos.engine_for("object_agent")
+    assert agent_eng is not None and agent_eng.close_after == 3
+    # scopes with nothing to inject stay fully inert (None)
+    assert chaos.engine_for("client") is None
+    assert chaos.engine_for("worker") is None
+
+
+def test_engine_decisions_and_schedule_are_deterministic():
+    """Same seed -> identical fault schedule AND identical per-message
+    decision sequence; a different seed diverges."""
+    from ray_tpu._private.chaos import ChaosEngine
+
+    plan = ("seed=42;drop:get@0.5;delay:put@1ms-9ms@0.5;"
+            "worker_kill:1@1s;conn_kill:client@2s")
+    msgs = ["get", "put", "get", "get", "put", "get"] * 20
+    a = ChaosEngine(plan, "hub")
+    b = ChaosEngine(plan, "hub")
+    acts_a = [a.message_action(m) for m in msgs]
+    acts_b = [b.message_action(m) for m in msgs]
+    assert acts_a == acts_b
+    assert [(f.kind, f.at) for f in a.timed] == [
+        ("worker_kill", 1.0), ("conn_kill", 2.0)
+    ]
+    c = ChaosEngine(plan.replace("seed=42", "seed=43"), "hub")
+    assert [c.message_action(m) for m in msgs] != acts_a
+    # sibling scopes draw from independent streams: consuming worker
+    # draws must not shift the hub's sequence
+    w = ChaosEngine("seed=42;delay:worker.exec@1ms-2ms", "worker")
+    assert w.rules and "exec" in w.rules
+
+
+def test_retry_delay_backoff_unit():
+    """Capped exponential backoff with full jitter (GL011's fix shape):
+    the step doubles to the cap; each wait lands in [step/2, step]."""
+    from ray_tpu._private.client import CoreClient
+
+    class Probe:
+        _RETRY_PERIOD_S = 0.2
+        _RETRY_MAX_S = 3.0
+        _retry_delay = CoreClient._retry_delay
+
+    p = Probe()
+    delay = p._RETRY_PERIOD_S
+    steps = []
+    for _ in range(8):
+        waited, nxt = p._retry_delay(delay)
+        assert delay * 0.5 <= waited <= delay
+        steps.append(delay)
+        delay = nxt
+    assert steps[:5] == [0.2, 0.4, 0.8, 1.6, 3.0]
+    assert delay == 3.0  # capped
+
+
+# --------------------------------------------------- plan-driven runtimes
+
+
+@pytest.fixture
+def plan_runtime(monkeypatch):
+    """Runtime factory: set a chaos plan (and friends) BEFORE init —
+    the hub reads the env at construction, workers inherit it."""
+    from ray_tpu._private.client import CoreClient
+
+    monkeypatch.setattr(CoreClient, "_RETRY_PERIOD_S", 0.2)
+
+    def start(plan, **env):
+        monkeypatch.setenv("RAY_TPU_CHAOS_PLAN", plan)
+        for k, v in env.items():
+            monkeypatch.setenv(k, str(v))
+        return ray_tpu.init(num_cpus=2, max_workers=2)
+
+    yield start
+    ray_tpu.shutdown()
+
+
+def _events():
+    from ray_tpu._private import worker
+
+    return worker.get_client().list_state("events")
+
+
+def test_plan_drop_and_delay_survive(plan_runtime):
+    plan_runtime("seed=1;drop:get@0.4;delay:wait@1ms-10ms;dup:kv_put@1")
+
+    @ray_tpu.remote
+    def f(i):
+        return i * 2
+
+    refs = [f.remote(i) for i in range(10)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=10, timeout=60)
+    assert len(ready) == 10 and not not_ready
+    assert ray_tpu.get(refs, timeout=60) == [i * 2 for i in range(10)]
+    client = ray_tpu._private.worker.get_client()
+    # dup: the duplicated idempotent write must not corrupt anything
+    for i in range(5):
+        assert client.kv_put(f"k{i}".encode(), f"v{i}".encode())
+        assert client.kv_get(f"k{i}".encode()) == f"v{i}".encode()
+    kinds = {e["kind"] for e in _events()}
+    assert "chaos_dup" in kinds
+
+
+def test_client_scope_outbound_drop(plan_runtime):
+    """drop:client.get — the CLIENT discards its own outbound GETs;
+    the backoff retransmit layer must still converge."""
+    plan_runtime("seed=2;drop:client.get@0.5")
+    from ray_tpu._private import worker
+
+    assert worker.get_client()._chaos is not None
+
+    @ray_tpu.remote
+    def g(i):
+        return i + 7
+
+    assert ray_tpu.get([g.remote(i) for i in range(8)], timeout=60) == [
+        i + 7 for i in range(8)
+    ]
+
+
+def test_worker_hang_then_timeout_kills_and_retries(plan_runtime):
+    """The satellite regression: chaos SIGSTOPs a busy worker; the
+    per-task options(timeout_s=...) deadline kills the stalled execute
+    and the retry completes on a fresh worker."""
+    plan_runtime("seed=5;worker_hang:1@0.6s")
+
+    @ray_tpu.remote(max_retries=2)
+    def slow(i):
+        time.sleep(1.0)
+        return i + 50
+
+    refs = [slow.options(timeout_s=2.0).remote(i) for i in range(3)]
+    assert ray_tpu.get(refs, timeout=90) == [50, 51, 52]
+    evs = _events()
+    kinds = [e["kind"] for e in evs]
+    assert "chaos_worker_hang" in kinds
+    assert "task_timeout" in kinds
+    assert any(
+        e["kind"] == "task_retry" and e.get("reason") == "timeout"
+        for e in evs
+    )
+
+
+def test_worker_hang_reaches_agent_spawned_workers(monkeypatch):
+    """Chaos worker faults must reach workers whose proc handle lives
+    with a node AGENT, not the hub (remote SIGSTOP/SIGKILL rides
+    P.KILL_WORKER's sig field): with every worker on an agent node the
+    fault still fires and the watchdog still recovers the stall."""
+    from ray_tpu.cluster_utils import Cluster
+
+    monkeypatch.setenv("RAY_TPU_CHAOS_PLAN", "seed=11;worker_hang:1@1s")
+    # above the 1.2s sleep so only the STALLED attempt trips it
+    monkeypatch.setenv("RAY_TPU_TASK_TIMEOUT_DEFAULT_S", "2.5")
+    cluster = Cluster(head_num_cpus=0)
+    try:
+        cluster.add_node(num_cpus=2)
+
+        @ray_tpu.remote(num_cpus=1, max_retries=2)
+        def slow(i):
+            time.sleep(1.2)
+            return i * 3
+
+        refs = [slow.remote(i) for i in range(2)]
+        assert ray_tpu.get(refs, timeout=90) == [0, 3]
+        evs = _events()
+        hangs = [e for e in evs if e["kind"] == "chaos_worker_hang"]
+        assert hangs, "worker_hang never fired with agent-only workers"
+        assert all(e.get("node_id") == "node1" for e in hangs), hangs
+    finally:
+        cluster.shutdown()
+
+
+def test_task_timeout_gives_up_past_retry_budget(plan_runtime):
+    plan_runtime("")  # no chaos: the watchdog alone
+
+    @ray_tpu.remote(max_retries=0)
+    def stuck():
+        time.sleep(60)
+
+    ref = stuck.options(timeout_s=0.5).remote()
+    with pytest.raises(ray_tpu.exceptions.TaskTimeoutError):
+        ray_tpu.get(ref, timeout=30)
+    assert any(e["kind"] == "task_timeout" for e in _events())
+
+
+def test_actor_call_timeout_kills_and_restarts(plan_runtime):
+    """Actor calls get the execute deadline too: a hung actor worker
+    never EOFs, so the timeout kill is the only recovery — in-flight
+    callers see ActorDiedError and the actor restarts per budget."""
+    plan_runtime("")  # no chaos: the deadline machinery alone
+
+    @ray_tpu.remote(max_restarts=1)
+    class S:
+        def stall(self):
+            time.sleep(60)
+
+        def ok(self):
+            return "ok"
+
+    s = S.remote()
+    ref = s.stall.options(timeout_s=0.5).remote()
+    with pytest.raises(ray_tpu.exceptions.ActorDiedError):
+        ray_tpu.get(ref, timeout=30)
+    # the restarted incarnation serves later calls
+    assert ray_tpu.get(s.ok.remote(), timeout=30) == "ok"
+    evs = _events()
+    assert any(
+        e["kind"] == "task_timeout" and e.get("actor_id") for e in evs
+    )
+    assert any(e["kind"] == "actor_restart" for e in evs)
+
+
+def test_chaos_state_and_inert_default(plan_runtime):
+    plan_runtime("seed=4;drop:get@0.2;worker_kill:1@50ms")
+
+    @ray_tpu.remote
+    def f():
+        time.sleep(0.3)
+        return 1
+
+    assert ray_tpu.get([f.remote() for _ in range(3)], timeout=60) == [1] * 3
+    from ray_tpu._private import worker
+
+    rows = worker.get_client().list_state("chaos")
+    assert rows and rows[0]["plan"].startswith("seed=4")
+    assert rows[0]["counts"].get("worker_kill") == 1
+    assert any(r.get("kind") == "chaos_worker_kill" for r in rows[1:])
+
+
+def test_chaos_survives_sharded_hub(plan_runtime, monkeypatch):
+    """Both control-plane topologies share the injection seam: with 4
+    reactor shards, drops hit the state plane's dispatch and a
+    conn_kill:worker expels through the owning shard's ring API."""
+    monkeypatch.setenv("RAY_TPU_HUB_SHARDS", "4")
+    plan_runtime("seed=6;drop:get@0.3;conn_kill:worker@0.5s")
+
+    @ray_tpu.remote(max_retries=2)
+    def f(i):
+        time.sleep(0.2)
+        return i * 11
+
+    refs = [f.remote(i) for i in range(8)]
+    assert ray_tpu.get(refs, timeout=90) == [i * 11 for i in range(8)]
+    kinds = [e["kind"] for e in _events()]
+    assert "chaos_conn_kill" in kinds
+    assert "worker_exit" in kinds  # the expelled worker died cleanly
+
+
+def test_chaos_cli_renders(plan_runtime, monkeypatch, capsys):
+    plan_runtime("seed=8;drop:get@0.2;worker_kill:1@100ms")
+
+    @ray_tpu.remote
+    def f():
+        time.sleep(0.3)
+        return 1
+
+    assert ray_tpu.get([f.remote() for _ in range(3)], timeout=60) == [1] * 3
+    # _connect reuses the live in-process runtime (ignore_reinit_error)
+    monkeypatch.setenv("RAY_TPU_ADDRESS", "in-process")
+    from ray_tpu import scripts
+
+    scripts.main(["chaos"])
+    out = capsys.readouterr().out
+    assert "plan: seed=8" in out
+    assert "worker_kill" in out
+    scripts.main(["chaos", "--format", "json"])
+    import json as _json
+
+    rows = _json.loads(capsys.readouterr().out)
+    assert rows and rows[0]["seed"] == 8
+
+
+def test_no_plan_is_inert(ray_start_regular):
+    """With no plan, every injection point is a cached None and
+    list_state("chaos") is empty."""
+    from ray_tpu._private import worker
+
+    assert worker._hub._chaos is None
+    assert worker.get_client()._chaos is None
+    assert worker.get_client().list_state("chaos") == []
+
+
+def test_delayed_redelivery_to_dead_conn_is_dropped(plan_runtime):
+    """Regression: a frame parked by delay: whose conn disconnects
+    inside the delay window must NOT replay when the timer fires —
+    stateful handlers (_on_hello) would re-register the dead conn in
+    client_conns, and with no second CONN_LOST ever pruning it the
+    phantom entry becomes the deterministic oldest-first conn_kill
+    victim. Both topologies close the conn in _safe_disconnect, so
+    closed-ness IS the disconnect signal the redelivery checks."""
+    plan_runtime("seed=1;drop:__unused__@1")  # any plan: live hub engine
+    from ray_tpu._private import worker
+
+    hub = worker._hub
+
+    class DeadConn:
+        closed = True
+
+    before = len(hub.client_conns)
+    hub._dispatch_after_chaos(DeadConn(), "hello", {"role": "client"})
+    assert len(hub.client_conns) == before, "dead conn re-registered"
+
+
+def test_get_retransmit_span_dedup_under_backoff(monkeypatch):
+    """PR 8 span-dedup under the new cadence: a get parked on a slow
+    task retransmits on the backoff schedule (fast base here -> several
+    resends), yet the hub emits exactly ONE hub.get span — the
+    _inflight_reqs dedup is keyed on (conn, req_id), not cadence."""
+    from ray_tpu._private.client import CoreClient
+
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    monkeypatch.setattr(CoreClient, "_RETRY_PERIOD_S", 0.05)
+    ray_tpu.init(num_cpus=2, max_workers=2, ignore_reinit_error=True)
+    try:
+        @ray_tpu.remote
+        def slow():
+            time.sleep(1.2)
+            return "v"
+
+        ref = slow.remote()
+        assert ray_tpu.get(ref, timeout=60) == "v"
+        from ray_tpu._private import worker
+
+        client = worker.get_client()
+        deadline = time.monotonic() + 10
+        spans = []
+        while time.monotonic() < deadline:
+            for row in client.list_state("traces"):
+                s = client.list_state("traces", trace_id=row["trace_id"])
+                if any(sp.get("name") == "hub.get" for sp in s):
+                    spans = s
+                    break
+            if spans:
+                break
+            time.sleep(0.1)
+        assert spans, "no traced get landed"
+        n_get = sum(1 for sp in spans if sp.get("name") == "hub.get")
+        assert n_get == 1, f"expected 1 hub.get span, got {n_get}"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_fetch_retransmit_during_reconstruction_parks(monkeypatch):
+    """Regression (soak flake): the backoff retransmit of a FETCH_OBJECT
+    that triggered a lineage rerun re-enters the hub while the object's
+    entry is marked not-ready for the reconstruction window. It must
+    park beside the original waiter — the old code replied "no such
+    segment" and the client surfaced ObjectLostError mid-recovery. The
+    fast retransmit base + the slow rerun guarantee several retransmits
+    land inside the window. FETCH_CHUNK is shrunk so the relay pull is
+    multi-chunk: the parked request must replay with its offset/length
+    intact (a bare req_id replay answers a chunk request with
+    whole-object bytes and corrupts the reassembled segment)."""
+    from ray_tpu._private.client import CoreClient
+    from ray_tpu.cluster_utils import Cluster
+
+    monkeypatch.setattr(CoreClient, "_RETRY_PERIOD_S", 0.05)
+    monkeypatch.setattr(CoreClient, "FETCH_CHUNK", 65536)
+    cluster = Cluster(head_num_cpus=2)
+    try:
+        node = cluster.add_node(num_cpus=2, resources={"eph": 4.0})
+
+        @ray_tpu.remote(resources={"eph": 1.0}, max_retries=2)
+        def make():
+            time.sleep(0.5)  # the rerun holds the window open
+            return np.arange(80_000, dtype=np.float64)  # shm segment
+
+        ref = make.remote()
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+        assert ready
+        cluster.remove_node(node)
+        cluster.add_node(num_cpus=2, resources={"eph": 4.0})
+        # fetch fails -> reconstruction parks it; retransmits at ~25-50ms
+        # must park too (idempotent per req_id), not error out — and the
+        # 10-chunk reassembly must be byte-exact through the replay
+        arr = ray_tpu.get(ref, timeout=60)
+        assert np.array_equal(arr, np.arange(80_000, dtype=np.float64))
+        from ray_tpu._private import worker
+
+        hub = worker._hub
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+            hub._reconstruct_waiters or hub._reconstructing
+        ):
+            time.sleep(0.1)
+        assert not hub._reconstruct_waiters, "parked fetches leaked"
+        assert not hub._reconstructing, "reconstruction flag leaked"
+    finally:
+        cluster.shutdown()

@@ -1209,6 +1209,134 @@ def test_reverting_shard_direct_disconnect_is_flagged():
     }
 
 
+# --------------------------------------------------------------------- GL011
+
+
+_GL011_OLD_LOOP = """
+    from concurrent.futures import wait as _fut_wait
+
+    class C:
+        _RETRY_PERIOD_S = 2.0
+
+        def request(self, msg_type, payload, fut, timeout=None):
+            import time as _time
+            deadline = (
+                None if timeout is None else _time.monotonic() + timeout
+            )
+            while True:
+                remaining = self._RETRY_PERIOD_S
+                if deadline is not None:
+                    remaining = min(remaining, deadline - _time.monotonic())
+                    if remaining <= 0:
+                        raise TimeoutError()
+                _fut_wait([fut], timeout=remaining)
+                if fut.done():
+                    return fut.result()
+                self.send(msg_type, payload)
+"""
+
+
+def test_gl011_flags_fixed_interval_retransmit():
+    # the shipped bug shape: the pre-fix GET retransmit loop — fixed
+    # ~2s cadence (the deadline min() is a clamp, not a backoff term)
+    assert "GL011" in codes_of(_GL011_OLD_LOOP, path=_PRIV)
+
+
+def test_gl011_flags_literal_cadence():
+    src = """
+    def pump(self):
+        while not self.done:
+            self.evt.wait(2.0)
+            self.conn.send_bytes(self.frame)
+    """
+    assert "GL011" in codes_of(src, path=_PRIV)
+
+
+def test_gl011_clean_with_multiplicative_backoff():
+    src = """
+    def pump(self):
+        delay = 0.2
+        while not self.done:
+            self.evt.wait(delay)
+            self.conn.send_bytes(self.frame)
+            delay = min(30.0, delay * 2.0)
+    """
+    assert "GL011" not in codes_of(src, path=_PRIV)
+
+
+def test_gl011_clean_with_backoff_helper_and_derived_delay():
+    # the shipped fix shape: the wait duration derives from a variable
+    # grown through a helper call (tuple unpack) — dataflow closure
+    # must see through the derivation
+    fixed = _GL011_OLD_LOOP.replace(
+        "remaining = self._RETRY_PERIOD_S",
+        "remaining, delay = self._retry_delay(delay)",
+    )
+    assert "GL011" not in codes_of(fixed, path=_PRIV)
+
+
+def test_gl011_clean_with_conditional_backoff_helper():
+    # the _wait_push shape: each wait is drawn from the helper, but the
+    # growth step is applied CONDITIONALLY through a second unpacked
+    # name (`cur = nxt` only when the wait timed out) — still backoff
+    src = """
+    def pump(self):
+        cur = 0.2
+        while not self.done:
+            remaining, nxt = self._retry_delay(cur, cap=8.0)
+            if not self.evt.wait(remaining):
+                cur = nxt
+                self.conn.send_bytes(self.frame)
+            else:
+                cur = 0.2
+    """
+    assert "GL011" not in codes_of(src, path=_PRIV)
+
+
+def test_gl011_clean_heartbeat_and_flush_loops():
+    # periodic SENDERS are not retransmit loops: a heartbeat paced on
+    # conn.poll, and a flush loop with no resend call
+    src = """
+    def run(self):
+        while True:
+            if self.conn.poll(1.0):
+                self.handle()
+            self.heartbeat()
+
+    def flush_loop(self):
+        while not self.closed:
+            self.evt.wait(timeout=0.25)
+            self.flush()
+    """
+    assert "GL011" not in codes_of(src, path=_PRIV)
+
+
+def test_gl011_scoped_to_private():
+    assert "GL011" not in codes_of(_GL011_OLD_LOOP, path="ray_tpu/serve/x.py")
+
+
+def test_reverting_client_fixed_retransmit_is_flagged():
+    """The real bug GL011 was written against: CoreClient.request
+    re-sent a parked request every fixed _RETRY_PERIOD_S forever. The
+    shipped fix draws each wait from _retry_delay (capped exponential
+    backoff + jitter); re-applying the fixed-period wait to the REAL
+    client.py source must trip GL011."""
+    client_path = os.path.join(PKG_DIR, "_private", "client.py")
+    with open(client_path) as f:
+        real = f.read()
+    assert "GL011" not in {
+        f.code for f in check_file(client_path, source=real)
+    }
+    reverted = real.replace(
+        "remaining, delay = self._retry_delay(delay)",
+        "remaining = self._RETRY_PERIOD_S",
+    )
+    assert reverted != real, "client.py no longer matches the revert"
+    assert "GL011" in {
+        f.code for f in check_file(client_path, source=reverted)
+    }
+
+
 # ------------------------------------------------------------- repo gate
 
 
@@ -1232,5 +1360,5 @@ def test_every_checker_is_exercised_by_the_gate_config():
     codes = {code for code, _name, _fn in all_checkers()}
     assert codes == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010",
+        "GL008", "GL009", "GL010", "GL011",
     }
