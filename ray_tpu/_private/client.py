@@ -288,7 +288,7 @@ class CoreClient:
                 drained = self._release_buf
                 self._release_buf = []
                 self._send_buf.append(
-                    ("release_owned", {"object_ids": drained})
+                    (P.RELEASE_OWNED, {"object_ids": drained})
                 )
             if self._send_buf:
                 buf, self._send_buf = self._send_buf, []
@@ -725,12 +725,14 @@ class CoreClient:
             sent = 0
             for piece in chunks:
                 sent += len(piece)
-                conn.send_bytes(dumps_frame(("obj_put", {
+                conn.send_bytes(dumps_frame((P.OBJ_PUT, {
                     "name": name, "data": piece, "last": sent >= total,
                 })))
             msg_type, p = loads_frame(conn.recv_bytes())
-            if msg_type != "obj_put_ok":
-                raise OSError(p.get("error") or f"unexpected frame {msg_type}")
+            if msg_type == P.OBJ_ERROR:
+                raise OSError(p.get("error") or "agent put failed")
+            if msg_type != P.OBJ_PUT_OK:
+                raise OSError(f"unexpected frame {msg_type}")
             ok = True
         finally:
             if ok:
@@ -829,14 +831,14 @@ class CoreClient:
         conn = self._agent_checkout(endpoint)
         ok = False
         try:
-            conn.send_bytes(dumps_frame(("obj_get", {"name": name})))
+            conn.send_bytes(dumps_frame((P.OBJ_GET, {"name": name})))
             with open(dst_tmp, "wb") as f:
                 while True:
                     msg_type, p = loads_frame(conn.recv_bytes())
-                    if msg_type != "obj_data":
-                        raise OSError(
-                            p.get("error") or f"unexpected frame {msg_type}"
-                        )
+                    if msg_type == P.OBJ_ERROR:
+                        raise OSError(p.get("error") or "agent fetch failed")
+                    if msg_type != P.OBJ_DATA:
+                        raise OSError(f"unexpected frame {msg_type}")
                     f.write(p["data"])
                     if p.get("last"):
                         break

@@ -173,6 +173,10 @@ class WorkerEntry:
     worker_id: str
     conn: Any = None
     proc: Any = None
+    # the worker's own os.getpid(), reported in its HELLO — the only
+    # pid the head has for agent-spawned workers (proc lives on the
+    # remote node agent, so proc.pid is unavailable here)
+    pid: Optional[int] = None
     node_id: str = "node0"
     runtime_env_hash: str = ""  # workers only serve matching runtime envs
     spawned_for_actor: bool = False  # purpose of the spawn (quota math)
@@ -1021,7 +1025,12 @@ class Hub:
         if not self._shards or not self._builtin_metrics:
             return
         for s in self._shards:
-            st = s.stats
+            # scrape-time read of the shard's monotonic counters: each
+            # field is written only by its shard thread and is a plain
+            # int (GIL-atomic load) — worst case one bump stale, never
+            # torn. The documented merge-at-scrape pattern (README
+            # "sharded control plane"), not a missing lock.
+            st = s.stats  # graftlint: disable=GL013 — scrape-time monotonic counter read
             tags = (("shard", str(s.idx)),)
             self._bm(
                 "ray_tpu_hub_reactor_wakeups_total", "counter",
@@ -1626,6 +1635,7 @@ class Hub:
                 self.workers[wid] = w
             w.conn = conn
             w.state = "idle"
+            w.pid = p.get("pid")
             w.connected_t = time.monotonic()
             self.conn_to_worker[conn] = wid
             node = self.nodes.get(w.node_id)
@@ -4799,7 +4809,7 @@ class Hub:
                 items.append({
                     "worker_id": w.worker_id, "state": w.state,
                     "node_id": w.node_id,
-                    "pid": w.proc.pid if w.proc else None,
+                    "pid": w.proc.pid if w.proc else w.pid,
                 })
         elif kind == "tasks":
             items = list(self.task_events)
@@ -4835,7 +4845,9 @@ class Hub:
             # reports its one implicit shard)
             if self._shards:
                 for s in self._shards:
-                    st = s.stats
+                    # same scrape-time monotonic-counter read as
+                    # _merge_shard_metrics (see the note there)
+                    st = s.stats  # graftlint: disable=GL013 — scrape-time monotonic counter read
                     items.append({
                         "shard": s.idx, "conns": st.conns,
                         "accepted": st.accepted, "wakeups": st.wakeups,

@@ -212,7 +212,7 @@ class NodeAgent:
                 [sys.executable, "-m", "ray_tpu._private.worker_process"],
                 env=env,
             )
-            self.children[p["env"]["RAY_TPU_WORKER_ID"]] = proc
+            self.children[p["worker_id"]] = proc
         elif msg_type == P.OBJ_READ:
             path = os.path.join(self.session_dir, "objects", p["name"])
             if not os.path.exists(path):

@@ -41,6 +41,7 @@ import threading
 from multiprocessing.connection import Listener
 from typing import Optional, Tuple
 
+from . import protocol as P
 from .debug import log_exc
 from .serialization import dumps_frame, loads_frame
 
@@ -127,12 +128,12 @@ class ObjectAgent:
         try:
             while True:
                 msg_type, p = loads_frame(conn.recv_bytes())
-                if msg_type == "obj_get":
+                if msg_type == P.OBJ_GET:
                     chunks_left = self._serve_get(conn, p, chunks_left)
                     if chunks_left == 0:
                         self._chaos.record("close_after")
                         return  # chaos: simulated mid-stream death
-                elif msg_type == "obj_put":
+                elif msg_type == P.OBJ_PUT:
                     put_state = self._serve_put(conn, p, put_state)
                     if chunks_left > 0:
                         chunks_left -= 1
@@ -141,7 +142,7 @@ class ObjectAgent:
                             return  # chaos: simulated mid-stream death
                 else:
                     conn.send_bytes(dumps_frame(
-                        ("obj_error", {"error": f"unknown verb {msg_type}"})
+                        (P.OBJ_ERROR, {"error": f"unknown verb {msg_type}"})
                     ))
         except (EOFError, OSError, ValueError):
             pass  # peer gone / torn frame: drop the connection
@@ -167,7 +168,7 @@ class ObjectAgent:
             if f is None:
                 raise OSError("bad segment name")
         except OSError as err:
-            conn.send_bytes(dumps_frame(("obj_error", {"error": str(err)})))
+            conn.send_bytes(dumps_frame((P.OBJ_ERROR, {"error": str(err)})))
             return chunks_left
         with f:
             total = os.fstat(f.fileno()).st_size
@@ -177,7 +178,7 @@ class ObjectAgent:
                 sent += len(data)
                 last = sent >= total
                 conn.send_bytes(dumps_frame(
-                    ("obj_data", {"data": data, "total": total, "last": last})
+                    (P.OBJ_DATA, {"data": data, "total": total, "last": last})
                 ))
                 if chunks_left > 0:
                     chunks_left -= 1
@@ -196,7 +197,7 @@ class ObjectAgent:
         if put_state is None:
             if not safe:
                 conn.send_bytes(dumps_frame(
-                    ("obj_error", {"error": f"bad segment name {name!r}"})
+                    (P.OBJ_ERROR, {"error": f"bad segment name {name!r}"})
                 ))
                 return None
             os.makedirs(self.objects_dir, exist_ok=True)
@@ -206,7 +207,7 @@ class ObjectAgent:
             put_state = (name, tmp, open(tmp, "wb"))
         elif put_state[0] != name:
             conn.send_bytes(dumps_frame(
-                ("obj_error", {"error": "interleaved puts on one connection"})
+                (P.OBJ_ERROR, {"error": "interleaved puts on one connection"})
             ))
             return put_state
         put_state[2].write(p["data"])
@@ -218,7 +219,7 @@ class ObjectAgent:
             with self._stats_lock:
                 self.bytes_received += size
                 self.transfers += 1
-            conn.send_bytes(dumps_frame(("obj_put_ok", {"size": size})))
+            conn.send_bytes(dumps_frame((P.OBJ_PUT_OK, {"size": size})))
             return None
         return put_state
 

@@ -30,6 +30,11 @@ PUT = "put"
 GET = "get"
 WAIT = "wait"
 FREE = "free"
+RELEASE_OWNED = "release_owned"  # owner-side GC: the last local handle
+                                 # died with the ref never pickled, so
+                                 # no other holder can exist — free the
+                                 # object(s). Batched client-side (rides
+                                 # the next flush's "batch" frame)
 CREATE_ACTOR = "create_actor"
 SUBMIT_ACTOR_TASK = "submit_actor_task"
 KILL_ACTOR = "kill_actor"
@@ -66,6 +71,10 @@ ACTOR_READY = "actor_ready"
 # worker-side spans and nested submits stitch into the same trace.
 # Absent the field (sampling off, the default) every path is untouched.
 SPAN_RECORD = "span_record"
+
+# any process -> hub: one util.metrics recording (counter inc / gauge
+# set / histogram observe); the hub folds it into its metric registry
+METRIC_RECORD = "metric_record"
 
 # streaming generators (reference: _raylet.pyx:280 ObjectRefGenerator)
 STREAM_YIELD = "stream_yield"    # worker -> hub: one yielded value
@@ -122,6 +131,18 @@ REPLICA_ADDED = "replica_added"    # client -> hub (async): a direct fetch
                                    # installed a copy of the segment on
                                    # this node; the directory adds it to
                                    # the object's replica set
+
+# client <-> object agent, on the agent's own endpoint (never the hub
+# conn). Same dumps_frame framing; request/response, replies read
+# inline by the caller rather than through a dispatch table.
+OBJ_GET = "obj_get"        # client -> agent: stream me a segment
+OBJ_DATA = "obj_data"      # agent -> client: one 8 MiB chunk {data,
+                           # total, last}
+OBJ_PUT = "obj_put"        # client -> agent: one inbound chunk {name,
+                           # data, last}
+OBJ_PUT_OK = "obj_put_ok"  # agent -> client: whole put landed {size}
+OBJ_ERROR = "obj_error"    # agent -> client: fetch/put failed {error};
+                           # the caller falls back to the hub relay
 
 # ---- readiness push (reference: the core worker's object-ready
 # callbacks from the local memory store instead of polling GCS): a

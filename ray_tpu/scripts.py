@@ -122,10 +122,16 @@ def cmd_start(args) -> None:
         signal.signal(signal.SIGTERM, _on_sigterm)
         # Head blocks for its lifetime (reference: ray start --block; a
         # non-blocking daemonizing head adds nothing on one host where
-        # drivers embed the hub in-process anyway).
+        # drivers embed the hub in-process anyway). Exits on Ctrl-C /
+        # SIGTERM, or when the hub reactor stops — a wire-level
+        # SHUTDOWN (`ray_tpu stop` from a remote operator) must bring
+        # the whole process down, not leave a zombie head with a dead
+        # reactor.
         try:
-            while True:
-                time.sleep(3600)
+            from ray_tpu._private import worker as _worker
+
+            while _worker._hub is not None and _worker._hub.thread.is_alive():
+                _worker._hub.thread.join(timeout=3.0)
         except KeyboardInterrupt:
             pass
         finally:
@@ -172,7 +178,36 @@ def cmd_stop(args) -> None:
         with open(_PID_FILE) as f:
             pid = int(f.read().strip())
     except (OSError, ValueError):
-        raise SystemExit("no recorded head pid (was `start --head` used?)")
+        # No local pid (the head runs remotely, or another user started
+        # it): ask the hub itself over the wire. SHUTDOWN flips the
+        # reactor's running flag; the hub tears the session down exactly
+        # as it would on SIGINT.
+        addr = _resolve_address(getattr(args, "address", None))
+        if addr is None:
+            raise SystemExit("no recorded head pid (was `start --head` used?)")
+        from ._private import protocol as P
+        from ._private.client import connect_hub
+        from ._private.serialization import dumps_frame
+
+        try:
+            conn = connect_hub(addr)
+            try:
+                conn.send_bytes(dumps_frame((P.SHUTDOWN, {})))
+            finally:
+                conn.close()
+        except OSError as err:
+            # dead hub / stale address (e.g. RAY_TPU_ADDRESS left
+            # exported after the head went down): report, don't
+            # traceback — and still drop the stale state files below
+            print(f"hub at {addr} unreachable ({err}); nothing to stop")
+        else:
+            print(f"sent shutdown to hub at {addr}")
+        for path in (_PID_FILE, _ADDR_FILE):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return
     try:
         os.kill(pid, signal.SIGINT)
         print(f"sent SIGINT to head (pid {pid})")
@@ -582,6 +617,7 @@ def _build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the head started by this CLI")
+    add_address(sp)
     sp.set_defaults(fn=cmd_stop)
 
     sp = sub.add_parser("status", help="cluster nodes + resources")

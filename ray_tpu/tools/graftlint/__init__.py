@@ -7,6 +7,7 @@ from .core import (  # noqa: F401
     DEFAULT_BASELINE_PATH,
     Finding,
     all_checkers,
+    all_project_checkers,
     check_file,
     check_paths,
     load_baseline,
@@ -17,6 +18,7 @@ __all__ = [
     "DEFAULT_BASELINE_PATH",
     "Finding",
     "all_checkers",
+    "all_project_checkers",
     "check_file",
     "check_paths",
     "load_baseline",

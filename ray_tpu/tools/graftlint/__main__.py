@@ -2,19 +2,31 @@
 
 Exit status: 0 when every finding is suppressed or baselined, 1 when
 new findings exist, 2 on usage errors. Findings print one per line as
-``path:line GLxxx message``.
+``path:line GLxxx message`` (or as one JSON object with
+``--format json``).
+
+``--changed-only`` reports per-file findings only in files git
+considers changed (worktree/index vs HEAD, plus untracked) — the fast
+pre-commit mode. The whole tree is still ANALYZED, and whole-program
+findings (GL012–GL014) always report regardless of where they anchor:
+deleting a handler must surface the sent-but-unhandled finding even
+though it anchors at the untouched send site.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from .core import (
     DEFAULT_BASELINE_PATH,
     all_checkers,
+    all_project_checkers,
     check_paths,
     load_baseline,
     write_baseline,
@@ -26,8 +38,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m ray_tpu.tools.graftlint",
         description=(
             "AST-based concurrency & distributed-runtime invariant "
-            "checker for this repo (rules GL001-GL006; see the package "
-            "README)."
+            "checker for this repo: per-file rules GL001-GL011 plus "
+            "whole-program passes GL012-GL014 (see the package README)."
         ),
     )
     parser.add_argument(
@@ -59,10 +71,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         "-q", "--quiet", action="store_true",
         help="suppress the summary line; print findings only",
     )
+    parser.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="output format (json: one object with findings + counts)",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="report per-file findings only in git-changed files "
+             "(whole-program findings always report; the whole tree "
+             "is always analyzed)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for code, name, _fn in sorted(all_checkers()):
+        for code, name, _fn in sorted(all_checkers() + all_project_checkers()):
             print(f"{code}  {name}")
         return 0
 
@@ -74,7 +96,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     codes = None
     if args.select:
         codes = {c.strip().upper() for c in args.select.split(",") if c.strip()}
-        known = {code for code, _name, _fn in all_checkers()}
+        known = {
+            code
+            for code, _name, _fn in all_checkers() + all_project_checkers()
+        }
         unknown = sorted(codes - known)
         if unknown:
             # a typo'd code must not silently run zero checkers and
@@ -86,11 +111,35 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             return 2
 
+    report_only: Optional[Set[str]] = None
+    if args.changed_only:
+        if args.write_baseline:
+            # a diff-scoped run drops every out-of-scope finding, so
+            # the written baseline would silently lose all accepted
+            # fingerprints outside the diff
+            print(
+                "graftlint: --write-baseline needs the full finding "
+                "set; drop --changed-only",
+                file=sys.stderr,
+            )
+            return 2
+        report_only = _git_changed_files(args.paths)
+        if report_only is None:
+            print(
+                "graftlint: --changed-only needs the analyzed paths "
+                "inside a git checkout (git rev-parse failed)",
+                file=sys.stderr,
+            )
+            return 2
+
     baseline = (
         set() if (args.no_baseline or args.write_baseline)
         else load_baseline(args.baseline)
     )
-    new, old = check_paths(args.paths, baseline=baseline, codes=codes)
+    new, old = check_paths(
+        args.paths, baseline=baseline, codes=codes,
+        report_only=report_only,
+    )
 
     if args.write_baseline:
         write_baseline(args.write_baseline, new + old)
@@ -101,6 +150,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         return 0
 
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [dataclasses.asdict(f) for f in new],
+                "baselined": len(old),
+                "changed_only": bool(args.changed_only),
+            },
+            indent=2, sort_keys=True,
+        ))
+        return 1 if new else 0
+
     for f in new:
         print(f.render())
     if not args.quiet:
@@ -110,6 +170,45 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
     return 1 if new else 0
+
+
+def _git_changed_files(paths: List[str]) -> Optional[Set[str]]:
+    """Absolute paths of files changed vs HEAD (worktree + index) plus
+    untracked files, for the checkout CONTAINING the analyzed paths —
+    not the process CWD, which may sit in an unrelated repo (running
+    graftlint on an absolute path from $HOME must not diff the
+    operator's dotfiles). None when no git checkout is found there."""
+    anchor = os.path.abspath(paths[0]) if paths else os.getcwd()
+    if not os.path.isdir(anchor):
+        anchor = os.path.dirname(anchor) or "."
+
+    def run(*cmd: str) -> Optional[List[str]]:
+        try:
+            r = subprocess.run(
+                list(cmd), capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            return None
+        return [ln for ln in r.stdout.splitlines() if ln.strip()]
+
+    top = run("git", "-C", anchor, "rev-parse", "--show-toplevel")
+    if not top:
+        return None
+    root = top[0]
+    names: Set[str] = set()
+    # vs HEAD covers both staged and unstaged edits; a repo with no
+    # commit yet has no HEAD — fall back to the index diff
+    diff = run("git", "-C", root, "diff", "--name-only", "HEAD", "--")
+    if diff is None:
+        diff = run("git", "-C", root, "diff", "--name-only", "--") or []
+    names.update(diff)
+    names.update(
+        run("git", "-C", root, "ls-files", "--others",
+            "--exclude-standard") or []
+    )
+    return {os.path.join(root, n) for n in names}
 
 
 if __name__ == "__main__":

@@ -14,4 +14,7 @@ from . import (  # noqa: F401
     gl009_unbounded_registry,
     gl010_cross_shard_state,
     gl011_retry_without_backoff,
+    gl012_protocol_conformance,
+    gl013_thread_ownership,
+    gl014_lock_order,
 )

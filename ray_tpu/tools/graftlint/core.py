@@ -10,6 +10,14 @@ Design notes:
 - Checkers are plain functions ``check(ctx) -> list[Finding]`` registered
   via :func:`register`. Keeping them stateless functions (no accumulating
   instance attributes) is deliberate — the analyzer lints its own package.
+- Whole-program passes (GL012+) are ``check(session) -> list[Finding]``
+  functions registered via :func:`register_project`; ``check_paths``
+  builds one :class:`~.project.ProjectSession` over the full file list
+  and runs them after the per-file rules.
+- Every file is parsed exactly ONCE per process, whatever the number of
+  checkers or passes that look at it: :func:`parse_cached` keys on
+  ``(mtime_ns, size)`` so per-file rules, the project session, and
+  repeated test invocations all share one AST.
 - Findings are fingerprinted as ``(path, code, symbol)`` rather than by
   line number, so a baseline survives unrelated edits to the same file.
 - Two suppression mechanisms:
@@ -32,9 +40,13 @@ __all__ = [
     "Finding",
     "FileContext",
     "register",
+    "register_project",
     "all_checkers",
+    "all_project_checkers",
     "check_file",
     "check_paths",
+    "parse_cached",
+    "parse_stats",
     "load_baseline",
     "write_baseline",
     "DEFAULT_BASELINE_PATH",
@@ -84,6 +96,7 @@ class FileContext:
         if source is None:
             with tokenize.open(path) as f:
                 source = f.read()
+        parse_stats["parses"] += 1
         tree = ast.parse(source, filename=path)
         ctx = cls(path=path, source=source, tree=tree,
                   lines=source.splitlines())
@@ -104,10 +117,43 @@ class FileContext:
         return full + sep + rest
 
 
+# --------------------------------------------------------------- parse cache
+#
+# One process-wide AST cache: 11 per-file rules plus the whole-program
+# session all want the same tree, and the tier-1 gate re-lints the full
+# package several times per test run (fixtures, revert tests, the gate
+# itself). Keyed on (mtime_ns, size) so an edited fixture file re-parses
+# while untouched runtime files never do. ``parse_stats`` is exported so
+# tests can assert the single-parse property directly.
+
+_PARSE_CACHE: Dict[str, Tuple[Tuple[int, int], "FileContext"]] = {}
+parse_stats = {"parses": 0, "hits": 0}
+
+
+def parse_cached(path: str) -> "FileContext":
+    """FileContext for ``path``, parsed at most once per file version."""
+    try:
+        st = os.stat(path)
+        key = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    if key is not None:
+        hit = _PARSE_CACHE.get(path)
+        if hit is not None and hit[0] == key:
+            parse_stats["hits"] += 1
+            return hit[1]
+    ctx = FileContext.parse(path)
+    if key is not None:
+        _PARSE_CACHE[path] = (key, ctx)
+    return ctx
+
+
 # ------------------------------------------------------------------ registry
 
 CheckerFn = Callable[[FileContext], List[Finding]]
 _CHECKERS: List[Tuple[str, str, CheckerFn]] = []
+# whole-program passes: fn(session: project.ProjectSession) -> findings
+_PROJECT_CHECKERS: List[Tuple[str, str, Callable]] = []
 
 
 def register(code: str, name: str) -> Callable[[CheckerFn], CheckerFn]:
@@ -118,10 +164,24 @@ def register(code: str, name: str) -> Callable[[CheckerFn], CheckerFn]:
     return deco
 
 
+def register_project(code: str, name: str) -> Callable:
+    def deco(fn):
+        _PROJECT_CHECKERS.append((code, name, fn))
+        return fn
+
+    return deco
+
+
 def all_checkers() -> List[Tuple[str, str, CheckerFn]]:
     from . import checkers as _checkers  # noqa: F401  (registration side effect)
 
     return list(_CHECKERS)
+
+
+def all_project_checkers() -> List[Tuple[str, str, Callable]]:
+    from . import checkers as _checkers  # noqa: F401  (registration side effect)
+
+    return list(_PROJECT_CHECKERS)
 
 
 # ------------------------------------------------------------------- helpers
@@ -277,19 +337,46 @@ def check_file(
     source: Optional[str] = None,
     codes: Optional[Set[str]] = None,
 ) -> List[Finding]:
-    """All (non-inline-suppressed) findings for one file."""
+    """All (non-inline-suppressed) findings for one file.
+
+    The whole-program passes run too, over a single-file session — so
+    fixtures exercise GL012+ without a tree. Passes needing more than
+    one module (GL012 is inert without a ``protocol`` module in the
+    session) are exercised through ``check_paths``, whose ``overrides``
+    let revert tests lint a modified copy of one real file against the
+    rest of the live tree.
+    """
+    ctx, err = _parse_context(path, source)
+    if ctx is None:
+        return [err]
+    out = _per_file_findings(ctx, codes)
+    out.extend(_project_findings_for([ctx], codes))
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
+
+def _parse_context(
+    path: str, source: Optional[str] = None
+) -> Tuple[Optional[FileContext], Optional[Finding]]:
+    """(context, None), or (None, GL000 finding) on a parse failure."""
     try:
-        ctx = FileContext.parse(path, source)
+        if source is None:
+            return parse_cached(path), None
+        return FileContext.parse(path, source), None
     except (SyntaxError, UnicodeDecodeError) as err:
-        return [
-            Finding(
-                path=path,
-                line=getattr(err, "lineno", 1) or 1,
-                code="GL000",
-                message=f"could not parse: {err.__class__.__name__}: {err}",
-                symbol="<parse>",
-            )
-        ]
+        return None, Finding(
+            path=path,
+            line=getattr(err, "lineno", 1) or 1,
+            code="GL000",
+            message=f"could not parse: {err.__class__.__name__}: {err}",
+            symbol="<parse>",
+        )
+
+
+def _per_file_findings(
+    ctx: FileContext, codes: Optional[Set[str]]
+) -> List[Finding]:
+    """All non-suppressed per-file-rule findings for one context."""
     out: List[Finding] = []
     for code, _name, fn in all_checkers():
         if codes is not None and code not in codes:
@@ -297,7 +384,30 @@ def check_file(
         for f in fn(ctx):
             if not _suppressed(f, ctx):
                 out.append(f)
-    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
+
+def _project_findings_for(
+    contexts: Sequence[FileContext], codes: Optional[Set[str]]
+) -> List[Finding]:
+    """Run the whole-program passes over one prepared session."""
+    selected = [
+        (code, name, fn)
+        for code, name, fn in all_project_checkers()
+        if codes is None or code in codes
+    ]
+    if not selected:
+        return []
+    from .project import ProjectSession
+
+    session = ProjectSession(list(contexts))
+    by_path = {ctx.path: ctx for ctx in contexts}
+    out: List[Finding] = []
+    for _code, _name, fn in selected:
+        for f in fn(session):
+            ctx = by_path.get(f.path)
+            if ctx is None or not _suppressed(f, ctx):
+                out.append(f)
     return out
 
 
@@ -323,12 +433,49 @@ def check_paths(
     paths: Sequence[str],
     baseline: Optional[Set[Tuple[str, str, str]]] = None,
     codes: Optional[Set[str]] = None,
+    overrides: Optional[Dict[str, str]] = None,
+    report_only: Optional[Set[str]] = None,
 ) -> Tuple[List[Finding], List[Finding]]:
-    """Returns (new_findings, baselined_findings)."""
+    """Returns (new_findings, baselined_findings).
+
+    The whole tree is parsed ONCE (per-file rules and the project
+    session share the cache) and the whole-program passes run over one
+    session covering every file.
+
+    ``overrides`` maps path -> replacement source (revert tests lint a
+    modified copy of a real file against the rest of the live tree).
+    ``report_only`` restricts reported PER-FILE findings to those paths
+    while still analyzing everything — the ``--changed-only`` mode.
+    Whole-program findings always report: their anchor line can sit in
+    an unchanged file while the causal edit is on the other side of the
+    relationship (delete a handler and the sent-but-unhandled finding
+    anchors at the untouched send site), so scoping them to the diff
+    would green-light exactly the breakage the passes exist to catch.
+    """
     baseline = baseline or set()
+    overrides = overrides or {}
+    report_abs = (
+        None if report_only is None
+        else {os.path.abspath(p) for p in report_only}
+    )
     new: List[Finding] = []
     old: List[Finding] = []
+    contexts: List[FileContext] = []
+    per_file: List[Finding] = []
     for fpath in iter_python_files(paths):
-        for f in check_file(fpath, codes=codes):
-            (old if f.fingerprint() in baseline else new).append(f)
+        ctx, err = _parse_context(fpath, overrides.get(fpath))
+        if ctx is None:
+            per_file.append(err)
+            continue
+        contexts.append(ctx)
+        per_file.extend(_per_file_findings(ctx, codes))
+    if report_abs is not None:
+        per_file = [
+            f for f in per_file
+            if os.path.abspath(f.path) in report_abs
+        ]
+    findings = per_file + _project_findings_for(contexts, codes)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    for f in findings:
+        (old if f.fingerprint() in baseline else new).append(f)
     return new, old

@@ -50,6 +50,7 @@ class Metric:
 
     def _record(self, value: float, tags: Optional[Dict[str, str]], op: str,
                 **extra):
+        from .._private import protocol as P
         from .._private import worker
 
         merged = dict(self._default_tags)
@@ -57,7 +58,7 @@ class Metric:
             merged.update(tags)
         client = worker.get_client()
         client.send_async(
-            "metric_record",
+            P.METRIC_RECORD,
             dict(
                 extra,
                 name=self._name,

@@ -1355,10 +1355,799 @@ def test_repo_is_clean_under_graftlint():
 
 
 def test_every_checker_is_exercised_by_the_gate_config():
-    from ray_tpu.tools.graftlint import all_checkers
+    from ray_tpu.tools.graftlint import all_checkers, all_project_checkers
 
     codes = {code for code, _name, _fn in all_checkers()}
     assert codes == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
         "GL008", "GL009", "GL010", "GL011",
     }
+    # the whole-program passes run through the same gate (check_paths
+    # builds one ProjectSession over the package and runs them after
+    # the per-file rules)
+    pcodes = {code for code, _name, _fn in all_project_checkers()}
+    assert pcodes == {"GL012", "GL013", "GL014"}
+
+
+# --------------------------------------------------------------------- GL012
+#
+# Protocol conformance needs a *session*: the contract lives in a
+# protocol module, send sites and dispatch tables live elsewhere. The
+# helper materializes a small multi-module project and runs only the
+# selected pass over it.
+
+
+def project_findings(tmp_path, files, codes):
+    d = tmp_path / "proj"
+    d.mkdir(exist_ok=True)
+    for name, src in files.items():
+        (d / name).write_text(textwrap.dedent(src))
+    new, _old = check_paths([str(d)], codes=set(codes))
+    return new
+
+
+GL012_PROTOCOL = """
+PING = "ping"
+PONG = "pong"
+GONE = "gone"
+"""
+
+GL012_HUB = """
+import protocol as P
+
+class Hub:
+    def __init__(self):
+        self._handlers = {
+            name[len("_on_"):]: getattr(self, name)
+            for name in dir(type(self))
+            if name.startswith("_on_")
+        }
+
+    def _on_ping(self, conn, p):
+        return p["x"] + p.get("opt", 0)
+
+    def _on_gone(self, conn, p):
+        return p["why"]
+"""
+
+
+def test_gl012_flags_the_conformance_matrix(tmp_path):
+    # one fixture, four defect classes: a send omitting a required key,
+    # a sent-but-unhandled type, a handled-but-never-sent type, and a
+    # raw string that bypasses the protocol module
+    client = """
+    import protocol as P
+
+    class Client:
+        def go(self, conn):
+            self.send(P.PING, {"y": 1})
+            self.send(P.PONG, {"z": 2})
+            self.send("pingg", {})
+    """
+    new = project_findings(
+        tmp_path,
+        {"protocol.py": GL012_PROTOCOL, "hub.py": GL012_HUB,
+         "client.py": client},
+        {"GL012"},
+    )
+    symbols = {f.symbol for f in new}
+    assert "<protocol>.pingg.unregistered" in symbols
+    assert "<protocol>.pong.unhandled" in symbols
+    assert "<protocol>.gone.never_sent" in symbols
+    # the send site misses the unconditionally-read key 'x'...
+    assert any(s.endswith(".ping.x.missing") for s in symbols), symbols
+    # ...and ships a key no handler reads ('y'); the .get-read 'opt'
+    # stays optional and unflagged
+    assert "<protocol>.ping.y.never_read" in symbols
+    assert not any(".opt." in s for s in symbols)
+
+
+def test_gl012_clean_on_a_conforming_project(tmp_path):
+    client = """
+    import protocol as P
+
+    class Client:
+        def go(self, conn):
+            self.send(P.PING, {"x": 1, "opt": 2})
+            self.send(P.GONE, {"why": "done"})
+            self.send(P.PONG, {"z": 2})
+
+        def _poll(self, conn):
+            mt, p = self.recv()
+            if mt == P.PONG:
+                return p["z"]
+    """
+    new = project_findings(
+        tmp_path,
+        {"protocol.py": GL012_PROTOCOL, "hub.py": GL012_HUB,
+         "client.py": client},
+        {"GL012"},
+    )
+    # PONG has no dispatch-table handler, but the client *compares*
+    # against it inline (the request/response idiom) — consumed
+    assert new == [], [f.render() for f in new]
+
+
+def test_gl012_topology_parity_between_reactor_and_shards(tmp_path):
+    # the single-reactor handler table and the sharded routing sets
+    # must cover the identical message set
+    proto = """
+    A = "a"
+    B = "b"
+    D = "d"
+    E = "e"
+    """
+    hub = """
+    import protocol as P
+
+    class Hub:
+        def __init__(self):
+            self._handlers = {
+                name[len("_on_"):]: getattr(self, name)
+                for name in dir(type(self))
+                if name.startswith("_on_")
+            }
+
+        def _on_a(self, conn, p):
+            return 1
+
+        def _on_b(self, conn, p):
+            return 2
+
+        def _on_d(self, conn, p):
+            return 3
+    """
+    shards = """
+    SCHEDULER_MSGS = frozenset({"a", "b", "e"})
+    """
+    client = """
+    import protocol as P
+
+    class Client:
+        def go(self):
+            self.send(P.A, {})
+            self.send(P.B, {})
+            self.send(P.D, {})
+            self.send(P.E, {})
+    """
+    new = project_findings(
+        tmp_path,
+        {"protocol.py": proto, "hub.py": hub, "hub_shards.py": shards,
+         "client.py": client},
+        {"GL012"},
+    )
+    symbols = {f.symbol for f in new}
+    # 'd' is handled by the hub but missing from the routing sets;
+    # 'e' is routed but the hub has no handler for it
+    assert "<topology>.d.unrouted" in symbols, symbols
+    assert "<topology>.e.unhandled" in symbols, symbols
+
+
+# --------------------------------------------------------------------- GL013
+
+
+GL013_PAIR = """
+import threading
+
+class ShardRing:
+    def push(self, item):
+        pass
+
+class Hub:
+    def __init__(self):
+        self.conns = {}
+
+    def start(self):
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        while True:
+            self._handle_disconnect(self.poll())
+
+    def _handle_disconnect(self, conn):
+        self.conns.pop(conn, None)
+
+class ReactorShard:
+    def __init__(self, hub):
+        self.hub = hub
+        self._state_ring = ShardRing()
+
+    def run(self):
+        while True:
+            conn = self.poll()
+            self._drop(conn)
+
+    def _drop(self, conn):
+        {access}
+"""
+
+
+def test_gl013_rejects_direct_cross_domain_call_but_accepts_ring():
+    """The satellite fixture pair: the SAME cross-thread hand-off is
+    flagged when made as a direct call into the foreign domain and
+    clean when pushed through the sanctioned ring crossing."""
+    direct = GL013_PAIR.replace(
+        "{access}", "self.hub._handle_disconnect(conn)")
+    ring = GL013_PAIR.replace(
+        "{access}", 'self._state_ring.push((conn, "conn_lost"))')
+    assert "GL013" in codes_of(direct)
+    assert "GL013" not in codes_of(ring)
+
+
+def test_gl013_flags_unlocked_intra_class_cross_thread_state():
+    src = """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.pending = {}
+
+        def start(self):
+            threading.Thread(target=self._reader, daemon=True).start()
+            threading.Thread(target=self._writer, daemon=True).start()
+
+        def _reader(self):
+            while True:
+                self.pending.pop(self.recv(), None)
+
+        def _writer(self):
+            while True:
+                self.pending[self.next_id()] = 1
+    """
+    assert "GL013" in codes_of(src)
+
+
+def test_gl013_accepts_locked_flagged_and_channel_crossings():
+    # the same two-thread shape, with every crossing sanctioned: the
+    # dict under a lock, a constant-only signal flag, and a queue
+    src = """
+    import queue
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.pending = {}
+            self._lock = threading.Lock()
+            self._running = True
+            self._q = queue.Queue()
+
+        def start(self):
+            threading.Thread(target=self._reader, daemon=True).start()
+            threading.Thread(target=self._writer, daemon=True).start()
+
+        def _reader(self):
+            while self._running:
+                with self._lock:
+                    self.pending.pop(self.recv(), None)
+                self._q.put(1)
+
+        def _writer(self):
+            while self._running:
+                with self._lock:
+                    self.pending[self.next_id()] = 1
+                self._q.get()
+
+        def stop(self):
+            self._running = False
+    """
+    assert "GL013" not in codes_of(src)
+
+
+def test_gl013_reads_of_foreign_mutable_state_need_a_lock():
+    # a monitor thread reading counters another thread writes — the
+    # cross-object *read* arm
+    src = """
+    import threading
+
+    class Shard:
+        def __init__(self):
+            self.depth = {}
+
+        def run(self):
+            while True:
+                self.depth[self.recv()] = 1
+
+    class Monitor:
+        def __init__(self, shard):
+            self.shard = shard
+
+        def start(self):
+            threading.Thread(target=self._scrape, daemon=True).start()
+
+        def _scrape(self):
+            while True:
+                self.report(self.shard.depth)
+    """
+    assert "GL013" in codes_of(src)
+
+
+# --------------------------------------------------------------------- GL014
+
+
+def test_gl014_flags_nested_lock_order_inversion():
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._alock = threading.Lock()
+            self._block = threading.Lock()
+
+        def one(self):
+            with self._alock:
+                with self._block:
+                    return 1
+
+        def two(self):
+            with self._block:
+                with self._alock:
+                    return 2
+    """
+    findings = [
+        f for f in check_file("x.py", source=textwrap.dedent(src))
+        if f.code == "GL014"
+    ]
+    assert len(findings) == 1
+    assert "Pool._alock" in findings[0].message
+    assert "Pool._block" in findings[0].message
+
+
+def test_gl014_clean_with_one_global_order():
+    src = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._alock = threading.Lock()
+            self._block = threading.Lock()
+
+        def one(self):
+            with self._alock:
+                with self._block:
+                    return 1
+
+        def two(self):
+            with self._alock:
+                with self._block:
+                    return 2
+    """
+    assert "GL014" not in codes_of(src)
+
+
+def test_gl014_sees_cycles_through_method_calls():
+    # the inversion hides behind a call: m1 holds left and calls into
+    # a method that takes right; m3 holds right and calls one that
+    # takes left. Only the transitive closure sees the cycle.
+    src = """
+    import threading
+
+    class Agent:
+        def m1(self):
+            with self._left_lock:
+                self.m2()
+
+        def m2(self):
+            with self._right_lock:
+                pass
+
+        def m3(self):
+            with self._right_lock:
+                self.m4()
+
+        def m4(self):
+            with self._left_lock:
+                pass
+    """
+    assert "GL014" in codes_of(src)
+
+
+def test_gl014_self_nesting_flagged_unless_rlock():
+    plain = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def m(self):
+            with self._lock:
+                with self._lock:
+                    pass
+    """
+    assert "GL014" in codes_of(plain)
+    reentrant = plain.replace("threading.Lock()", "threading.RLock()")
+    assert "GL014" not in codes_of(reentrant)
+
+
+# ---------------------------------------------- whole-program revert tests
+
+
+def test_reverting_node_agent_worker_id_read_is_flagged():
+    """The real conformance gap this PR closed: every SPAWN_WORKER send
+    shipped a top-level 'worker_id' the node agent never read (it dug
+    the id out of the env dict instead) — dead wire weight invisible
+    per-file. Re-applying the env-dict read must trip GL012."""
+    agent_path = os.path.join(PKG_DIR, "_private", "node_agent.py")
+    with open(agent_path) as f:
+        real = f.read()
+    reverted = real.replace(
+        'self.children[p["worker_id"]] = proc',
+        'self.children[p["env"]["RAY_TPU_WORKER_ID"]] = proc',
+    )
+    assert reverted != real, "node_agent.py no longer matches the revert"
+    new, _ = check_paths(
+        [PKG_DIR], overrides={agent_path: reverted}, codes={"GL012"},
+    )
+    assert any(
+        f.symbol == "<protocol>.spawn_worker.worker_id.never_read"
+        for f in new
+    ), [f.render() for f in new]
+
+
+def test_reverting_shard_direct_disconnect_trips_gl013_too():
+    """The documented historical bug behind GL010, re-checked by the
+    inferred-ownership pass: the first shard draft called
+    hub._handle_disconnect(conn) from the shard thread instead of
+    pushing CONN_LOST onto the state ring. GL013 must flag it WITHOUT
+    GL010's hand-labelled base names — purely from domain inference."""
+    shards_path = os.path.join(PKG_DIR, "_private", "hub_shards.py")
+    with open(shards_path) as f:
+        real = f.read()
+    reverted = real.replace(
+        "self._state_ring.push((conn, None, CONN_LOST, None))",
+        "self.hub._handle_disconnect(conn)",
+    )
+    assert reverted != real, "hub_shards.py no longer matches the revert"
+    new, _ = check_paths(
+        [PKG_DIR], overrides={shards_path: reverted}, codes={"GL013"},
+    )
+    assert any(
+        f.code == "GL013" and "_handle_disconnect" in f.symbol for f in new
+    ), [f.render() for f in new]
+
+
+def test_inverting_client_lock_order_is_flagged():
+    """The deadlock shape the client's lock discipline prevents:
+    _invalidate_resolve touches the resolve cache and the agent pool
+    SEQUENTIALLY (drop cache lock, then take pool lock). Nesting the
+    two acquisitions — cache->pool in invalidate, pool->cache in
+    checkout — is the classic AB/BA inversion; GL014 must flag the
+    cycle across the two methods."""
+    client_path = os.path.join(PKG_DIR, "_private", "client.py")
+    with open(client_path) as f:
+        real = f.read()
+    reverted = real.replace(
+        "        with self._obj_cache_lock:\n"
+        "            self._resolve_cache.pop(oid_bytes, None)\n",
+        "        with self._obj_cache_lock:\n"
+        "            with self._agent_pool_lock:\n"
+        "                self._resolve_cache.pop(oid_bytes, None)\n",
+    ).replace(
+        "        with self._agent_pool_lock:\n"
+        "            pool = self._agent_pool.get(endpoint)\n",
+        "        with self._agent_pool_lock:\n"
+        "            with self._obj_cache_lock:\n"
+        "                pool = self._agent_pool.get(endpoint)\n",
+    )
+    assert reverted != real, "client.py no longer matches the revert"
+    new, _ = check_paths(
+        [PKG_DIR], overrides={client_path: reverted}, codes={"GL014"},
+    )
+    assert any(
+        f.code == "GL014"
+        and "_obj_cache_lock" in f.message
+        and "_agent_pool_lock" in f.message
+        for f in new
+    ), [f.render() for f in new]
+
+
+# ------------------------------------------------------- analysis session
+
+
+def test_session_resolves_real_dispatch_tables_and_send_sites():
+    """The module-index satellite: the protocol model must find every
+    dispatch-table spelling and the batch-frame send site in the REAL
+    tree, or the conformance pass is checking a fiction."""
+    from ray_tpu.tools.graftlint.project import session_for
+
+    sess = session_for([PKG_DIR])
+    pm = sess.protocol()
+    assert len(pm.constants) >= 60  # protocol.py is the catalog
+
+    # dict-literal table: CoreClient._inbound_handlers
+    dict_tables = [
+        t for t in pm.tables if t.kind == "dict" and t.owner == "CoreClient"
+    ]
+    assert dict_tables, "CoreClient dict table not resolved"
+    assert {"reply", "pubsub_msg", "cancel_task", "ready_push"} <= set(
+        dict_tables[0].msgs
+    )
+
+    # dir()/_on_ convention table: Hub._handlers
+    hub_tables = [
+        t for t in pm.tables if t.kind == "prefix" and t.owner == "Hub"
+    ]
+    assert hub_tables and len(hub_tables[0].msgs) >= 40
+    assert "submit_task" in hub_tables[0].msgs
+
+    # if/elif chains: the node agent's _handle
+    elif_owners = {t.owner for t in pm.tables if t.kind == "elif"}
+    assert any("_handle" in o for o in elif_owners), elif_owners
+
+    # batch-frame send site: release_owned rides the client send buffer
+    batch = [s for s in pm.sends if s.msg == "release_owned"]
+    assert batch, "release_owned batch-append send site not resolved"
+    assert batch[0].via == "append"
+    assert batch[0].keys is not None and "object_ids" in batch[0].keys
+
+    # sharded routing sets mirror hub_shards.SERVICE_OF inputs
+    routed = set()
+    for r in pm.routing_sets:
+        if r.sharded:
+            routed |= r.msgs
+    assert {"submit_task", "put", "subscribe"} <= routed
+
+    # inline request/response comparisons count as consumption
+    assert "obj_data" in pm.compared and "obj_put_ok" in pm.compared
+
+
+def test_thread_model_seeds_the_documented_entry_points():
+    from ray_tpu.tools.graftlint.project import session_for
+
+    sess = session_for([PKG_DIR])
+    tm = sess.threads()
+    shard = tm.resolve("ReactorShard")
+    assert any("ReactorShard.run" in d for d in shard.domains.get("run", ()))
+    client = tm.resolve("CoreClient")
+    assert any(
+        "_read_loop" in d for d in client.domains.get("_read_loop", ())
+    )
+    # dispatch-table handlers inherit their dispatcher's domain: the
+    # client's _on_reply runs wherever the reader loop runs
+    reply_domains = client.domains.get("_on_reply") or set()
+    assert reply_domains & (client.domains.get("_read_loop") or set())
+
+
+# ------------------------------------------------------------- parse cache
+
+
+def test_parse_cache_one_parse_per_file_and_no_rescan_regression():
+    """The perf satellite: all 14 checkers (11 per-file + 3 whole-
+    program) share ONE parse of each file, a second full-tree run
+    re-parses nothing, and the cached run is not slower than the
+    parse-paying run despite the added whole-program passes."""
+    import time as _time
+
+    from ray_tpu.tools.graftlint.core import (
+        _PARSE_CACHE,
+        iter_python_files,
+        parse_stats,
+    )
+
+    _PARSE_CACHE.clear()
+    n_files = sum(1 for _ in iter_python_files([PKG_DIR]))
+    assert n_files > 100
+
+    p0 = parse_stats["parses"]
+    t0 = _time.monotonic()
+    check_paths([PKG_DIR])
+    t_cold = _time.monotonic() - t0
+    assert parse_stats["parses"] - p0 == n_files
+
+    p1 = parse_stats["parses"]
+    h1 = parse_stats["hits"]
+    t0 = _time.monotonic()
+    check_paths([PKG_DIR])
+    t_warm = _time.monotonic() - t0
+    assert parse_stats["parses"] == p1, "warm run re-parsed files"
+    assert parse_stats["hits"] - h1 == n_files
+    # the cache must actually pay: a full 14-checker warm run beats the
+    # cold run that had to parse (1.1 slack absorbs box noise)
+    assert t_warm < t_cold * 1.1, (t_cold, t_warm)
+    # absolute backstop so a pathological whole-program blowup fails
+    # loudly even if both runs regress together
+    assert t_cold < 60, t_cold
+
+
+# ------------------------------------------------------ json / changed-only
+
+
+def test_cli_json_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def fire(actor):\n    actor.ping.remote()\n")
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad),
+         "--format", "json"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 1
+    data = json.loads(r.stdout)
+    assert data["baselined"] == 0 and data["changed_only"] is False
+    assert [f["code"] for f in data["findings"]] == ["GL004"]
+    assert data["findings"][0]["path"] == str(bad)
+    assert data["findings"][0]["line"] == 2
+
+    good = tmp_path / "good.py"
+    good.write_text("def add(a, b):\n    return a + b\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(good),
+         "--format", "json"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["findings"] == []
+
+
+def test_cli_changed_only_scopes_reporting_to_the_git_diff(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+
+    def git(*cmd):
+        r = subprocess.run(
+            ["git", "-C", str(repo), "-c", "user.email=t@t",
+             "-c", "user.name=t", *cmd],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        return r.stdout
+
+    git("init", "-q")
+    committed = repo / "committed.py"
+    committed.write_text("def fire(actor):\n    actor.ping.remote()\n")
+    git("add", "committed.py")
+    git("commit", "-qm", "seed")
+
+    # an untracked file with a fresh bug
+    fresh = repo / "fresh.py"
+    fresh.write_text("def fire(actor):\n    actor.ping.remote()\n")
+
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(repo),
+         "--changed-only", "--format", "json"],
+        capture_output=True, text=True, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    data = json.loads(r.stdout)
+    paths = {f["path"] for f in data["findings"]}
+    # the committed bug is invisible in changed-only mode; the fresh
+    # file's finding is reported
+    assert paths == {str(fresh)}, paths
+    assert data["changed_only"] is True
+
+    # once everything is committed the diff is empty: exit 0, nothing
+    # reported (the committed bug still exists — full runs see it)
+    git("add", "fresh.py")
+    git("commit", "-qm", "fresh")
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(repo),
+         "--changed-only", "--format", "json"],
+        capture_output=True, text=True, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.loads(r.stdout)["findings"] == []
+
+    r = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(repo)],
+        capture_output=True, text=True, env=env, cwd=str(repo),
+    )
+    assert r.returncode == 1  # full run still reports both
+
+
+def test_gl013_bare_annotation_is_not_a_write():
+    # `self.pending: dict` declares without assigning; treating it as a
+    # write fabricated cross-thread conflicts
+    src = """
+    import threading
+
+    class Pump:
+        def start(self):
+            threading.Thread(target=self._reader, daemon=True).start()
+            threading.Thread(target=self._writer, daemon=True).start()
+
+        def _reader(self):
+            while True:
+                self.pending: dict
+                self.consume(self.pending)
+
+        def _writer(self):
+            while True:
+                self.report(len(self.pending))
+    """
+    assert "GL013" not in codes_of(src)
+
+
+def test_same_named_classes_in_different_modules_both_analyzed(tmp_path):
+    # the thread/lock models key by (module, class): a second class
+    # carrying an already-seen name must not be silently dropped, and
+    # its same-named locks are DIFFERENT locks (no phantom cycles)
+    a = """
+    import threading
+
+    class Backend:
+        def __init__(self):
+            self._alock = threading.Lock()
+            self._block = threading.Lock()
+
+        def m(self):
+            with self._alock:
+                with self._block:
+                    pass
+    """
+    b = """
+    import threading
+
+    class Backend:
+        def __init__(self):
+            self._alock = threading.Lock()
+            self._block = threading.Lock()
+
+        def m(self):
+            with self._block:
+                with self._alock:
+                    pass
+    """
+    # opposite nesting orders, but in two DIFFERENT classes that merely
+    # share a name: no shared lock, no cycle
+    new = project_findings(
+        tmp_path, {"mod_a.py": a, "mod_b.py": b}, {"GL014"})
+    assert new == [], [f.render() for f in new]
+    # ...and GL013 still analyzes BOTH same-named classes: give the
+    # second a real cross-thread bug and it must be flagged even
+    # though a clean class with the same name was indexed first
+    buggy = """
+    import threading
+
+    class Backend:
+        def start(self):
+            threading.Thread(target=self._reader, daemon=True).start()
+            threading.Thread(target=self._writer, daemon=True).start()
+
+        def _reader(self):
+            while True:
+                self.pending.pop(self.recv(), None)
+
+        def _writer(self):
+            while True:
+                self.pending[self.next_id()] = 1
+    """
+    new2 = project_findings(
+        tmp_path, {"mod_a.py": a, "mod_c.py": buggy}, {"GL013"})
+    assert any(f.code == "GL013" and f.path.endswith("mod_c.py")
+               for f in new2), [f.render() for f in new2]
+
+
+def test_changed_only_keeps_whole_program_findings(tmp_path):
+    # deleting a handler anchors the sent-but-unhandled finding at the
+    # UNCHANGED send site; report_only must not filter it away
+    d = tmp_path / "proj2"
+    d.mkdir()
+    (d / "protocol.py").write_text("PING = \"ping\"\n")
+    (d / "client.py").write_text(textwrap.dedent("""
+    import protocol as P
+
+    class Client:
+        def go(self):
+            self.send(P.PING, {})
+    """))
+    hub = d / "hub.py"
+    hub.write_text(textwrap.dedent("""
+    import protocol as P
+    """))
+    # pretend only hub.py changed (the handler was deleted from it):
+    # the GL012 finding anchors in client.py yet must still be reported
+    new, _ = check_paths(
+        [str(d)], codes={"GL012"}, report_only={str(hub)},
+    )
+    assert any(
+        f.symbol == "<protocol>.ping.unhandled" for f in new
+    ), [f.render() for f in new]
+    # ...while per-file findings outside the changed set stay scoped
+    (d / "extra.py").write_text(
+        "def fire(actor):\n    actor.ping.remote()\n")
+    new2, _ = check_paths(
+        [str(d)], codes={"GL004", "GL012"}, report_only={str(hub)},
+    )
+    assert not any(f.code == "GL004" for f in new2)
