@@ -39,6 +39,16 @@ import numpy as np
 BASELINES = {
     "single_client_tasks_sync": 963.0,
     "single_client_tasks_async": 7293.0,
+    # net-new rows (no reference analogue), baselines measured on this
+    # repo's CI box at their introduction (PR 12):
+    # - tasks_bulk: the single_client_tasks_async shape submitted as
+    #   ONE SUBMIT_TASKS wire frame via RemoteFunction.map — the
+    #   vectorized fan-out path
+    # - submit_path_overhead: client-side CPU µs to stage one task
+    #   onto the wire (encode + id draw + payload build + frame
+    #   pickle), no cluster; LOWER is better (see _LOWER_IS_BETTER)
+    "single_client_tasks_bulk": 8315.0,
+    "submit_path_overhead": 5.9,
     "multi_client_tasks_async": 22747.0,
     # net-new row (no reference analogue): two client processes
     # submitting concurrently under distinct REGISTERED tenants, so the
@@ -78,6 +88,11 @@ BASELINES = {
     "tracing_overhead": 1.0,
 }
 
+# rows where a SMALLER value is the improvement (latency/overhead
+# rows); report() inverts their vs_baseline so >1.0 always means
+# "better than baseline" across the table and the geomean
+_LOWER_IS_BETTER = {"submit_path_overhead"}
+
 SMOKE = False
 QUICK = False
 TRIALS = None  # --trials N: median-of-N, per-trial values in the JSON
@@ -116,11 +131,17 @@ def report(metric: str, value, unit: str) -> None:
         trials_list = [round(v, 3) for v in value]
         value = float(np.median(value))
     base = BASELINES.get(metric)
+    if base and metric in _LOWER_IS_BETTER:
+        ratio = base / value
+    elif base:
+        ratio = value / base
+    else:
+        ratio = None
     rec = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
-        "vs_baseline": round(value / base, 3) if base else None,
+        "vs_baseline": round(ratio, 3) if ratio else None,
     }
     if trials_list is not None:
         rec["trials"] = trials_list
@@ -226,6 +247,53 @@ def main() -> None:
         return N_ASYNC
 
     report("single_client_tasks_async", timeit(tasks_async), "tasks/s")
+
+    def tasks_bulk():
+        # same shape as tasks_async but all N tasks ride ONE
+        # SUBMIT_TASKS frame (RemoteFunction.map): one encode of the
+        # shared fields, one id slab, one hub admission pass
+        ray_tpu.get(nullary.map([()] * N_ASYNC))
+        return N_ASYNC
+
+    report("single_client_tasks_bulk", timeit(tasks_bulk), "tasks/s")
+
+    def submit_path():
+        # client-side CPU to stage tasks onto the wire: encode args,
+        # draw ids, build the SUBMIT_TASKS payload, pickle the frame —
+        # no sockets, so this isolates the per-call submit overhead the
+        # template/slab work targets from scheduler + worker time
+        from ray_tpu._private import protocol as _P
+        from ray_tpu._private.ids import id_slab
+        from ray_tpu._private.serialization import dumps_frame
+        from ray_tpu.remote_function import encode_args
+
+        n = 64 if SMOKE else 4096
+        encoded = [encode_args(None, (i,), {}) for i in range(n)]
+        slab = id_slab(2 * n)
+        payload = {
+            "fn_id": "bench_fn",
+            "resources": {"CPU": 1.0},
+            "options": {"max_retries": 3},
+            "tasks": [
+                {
+                    "task_id": slab[i],
+                    "args_kind": e[0],
+                    "args_payload": e[1],
+                    "arg_deps": e[2],
+                    "return_ids": [slab[n + i]],
+                }
+                for i, e in enumerate(encoded)
+            ],
+        }
+        dumps_frame((_P.SUBMIT_TASKS, payload))
+        return n
+
+    rate = timeit(submit_path)
+    report(
+        "submit_path_overhead",
+        [1e6 / r for r in rate] if isinstance(rate, list) else 1e6 / rate,
+        "us/task",
+    )
 
     # 4 client processes each submitting a quarter of the tasks
     # (reference shape: ray_perf.py "multi client tasks async")

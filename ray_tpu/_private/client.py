@@ -14,6 +14,7 @@ run an executor loop (worker_process.py) fed from `task_queue`.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import os
 import queue
@@ -27,7 +28,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .. import exceptions
 from . import protocol as P
 from .debug import log_exc
-from .ids import ActorID, ObjectID, TaskID
+from .ids import ActorID, ObjectID, TaskID, id_slab
 from .object_store import INLINE_THRESHOLD, ShmObjectStore
 from .serialization import (
     dumps_frame,
@@ -104,6 +105,31 @@ class CoreClient:
         self._send_lock = threading.Lock()
         self._send_buf: List[tuple] = []
         self._buf_evt = threading.Event()
+        # adaptive outbound coalescing (mirrors the hub's outbox
+        # batching): the inline-flush threshold starts small so a
+        # trickle of messages drains promptly, widens ×2 each time a
+        # burst fills the window (fewer syscalls per message while the
+        # producer is outrunning the drain), and decays when timer
+        # flushes see small batches. _buf_cost tracks payload bytes for
+        # size-aware flushing — a few large puts must not wait out the
+        # message-count window.
+        self._coalesce_msgs = 32
+        self._buf_cost = 0
+        # >0 while inside batch_window(): count-based flushes are held
+        # so a caller-visible burst (ActorPool.map) leaves as few
+        # frames as possible; the byte ceiling still applies.
+        self._window_depth = 0
+        # bulk-submit ack tracking: req_id -> [future, payload,
+        # next_resend_t, backoff]. SUBMIT_TASKS is fire-and-forget for
+        # the caller, so the flusher thread owns the retransmit
+        # schedule (see _scan_unacked); the hub's per-task dedup makes
+        # replays safe. FIFO-bounded.
+        self._unacked_bulk: Dict[int, list] = {}
+        # registration epoch: RemoteFunction memoizes its export
+        # against this value, so a reconnect (shutdown + re-init = a
+        # NEW CoreClient with a fresh epoch) naturally invalidates
+        # every cached registration
+        self.client_epoch = next(CoreClient._EPOCH_COUNTER)
         # ownership-GC release ids, appended from ObjectRef.__del__.
         # __del__ can run at ANY allocation point — including while THIS
         # thread already holds _send_lock (GC during dumps_inline) — so
@@ -253,12 +279,19 @@ class CoreClient:
         with self._send_lock:
             if self._send_buf:
                 buf, self._send_buf = self._send_buf, []
+                self._buf_cost = 0
                 buf.append((msg_type, payload))
                 self.conn.send_bytes(dumps_frame(("batch", buf)))
             else:
                 self.conn.send_bytes(dumps_frame((msg_type, payload)))
 
-    def send_async(self, msg_type: str, payload: dict) -> None:
+    def send_async(self, msg_type: str, payload: dict,
+                   cost: int = 0) -> None:
+        """Buffered send. ``cost`` is the caller's estimate of the
+        payload's wire size when it knows it (put_value passes the
+        encoded value size); the buffer flushes early once accumulated
+        cost crosses _COALESCE_MAX_BYTES, so big payloads don't sit
+        out the message-count window."""
         dup = False
         if self._chaos is not None:
             k = self._chaos.outbound_send(msg_type)
@@ -272,8 +305,19 @@ class CoreClient:
                 # duplicate appended under the SAME acquisition so the
                 # buffer-empty wake below still fires for this batch
                 self._send_buf.append((msg_type, payload))
-            if len(self._send_buf) >= 128:
+            self._buf_cost += cost
+            if ((len(self._send_buf) >= self._coalesce_msgs
+                    and self._window_depth == 0)
+                    or self._buf_cost >= self._COALESCE_MAX_BYTES):
                 buf, self._send_buf = self._send_buf, []
+                self._buf_cost = 0
+                if len(buf) >= self._coalesce_msgs:
+                    # the producer filled the window before the flusher
+                    # woke: widen it so a sustained burst pays fewer
+                    # syscalls (and fewer hub wakeups) per message
+                    self._coalesce_msgs = min(
+                        self._coalesce_msgs * 2, self._COALESCE_CEIL
+                    )
                 self.conn.send_bytes(dumps_frame(("batch", buf)))
                 return
         if was_empty:
@@ -292,10 +336,36 @@ class CoreClient:
                 )
             if self._send_buf:
                 buf, self._send_buf = self._send_buf, []
+                self._buf_cost = 0
                 self.conn.send_bytes(dumps_frame(("batch", buf)))
+                if len(buf) * 4 <= self._coalesce_msgs:
+                    # a timer/explicit drain caught a small batch: the
+                    # burst is over — decay the window so the next
+                    # trickle of messages flushes promptly again
+                    self._coalesce_msgs = max(
+                        self._COALESCE_FLOOR, self._coalesce_msgs // 2
+                    )
+
+    @contextlib.contextmanager
+    def batch_window(self):
+        """Hold count-based coalescing flushes while a caller-visible
+        burst is produced (ActorPool.map submits N actor tasks that
+        cannot ride a SUBMIT_TASKS frame); on exit the whole burst is
+        drained in one flush. The byte ceiling still flushes mid-window
+        so a burst of large payloads can't buffer unboundedly. Safe to
+        nest; the background flusher may still drain on its timer, which
+        only costs an extra frame, never reorders (per-conn FIFO)."""
+        with self._send_lock:
+            self._window_depth += 1
+        try:
+            yield
+        finally:
+            with self._send_lock:
+                self._window_depth -= 1
+            self.flush()
 
     def _flush_loop(self) -> None:
-        # Catches stray buffered messages ~0.5ms after the burst ends
+        # Catches stray buffered messages right after a burst ends
         # (send latency is event-driven: send_async sets _buf_evt on the
         # first buffered message). The wait timeout doubles as the drain
         # cadence for the lock-free release buffer (__del__ can't signal
@@ -305,13 +375,43 @@ class CoreClient:
         # idle workers doesn't burn the core with timer wakeups.
         while not self._closed:
             timeout = 0.05 if self._release_buf else 0.25
-            self._buf_evt.wait(timeout=timeout)
+            fired = self._buf_evt.wait(timeout=timeout)
             self._buf_evt.clear()
-            time.sleep(0.0005)
+            if fired and len(self._send_buf) >= 8:
+                # a burst is mid-flight: one scheduler quantum lets the
+                # producer coalesce more before we drain. Below that,
+                # the old unconditional nap only ADDED latency to a
+                # lone urgent message — skip it.
+                time.sleep(0.0005)
             try:
+                self._scan_unacked()
                 self.flush()
             except (OSError, BrokenPipeError):
                 return
+
+    def _scan_unacked(self) -> None:
+        """Retransmit bulk submits whose ack never came (flusher
+        thread). A SUBMIT_TASKS frame dropped on the wire would
+        otherwise lose N tasks silently — the hub acks each batch via
+        REPLY(req_id), and any batch still unacked past its jittered
+        backoff deadline is re-sent whole (per-task dedup in
+        _on_submit_tasks makes the replay idempotent)."""
+        if not self._unacked_bulk:
+            return
+        now = time.monotonic()
+        acked = None
+        for req_id, entry in list(self._unacked_bulk.items()):
+            if entry[0].done():
+                if acked is None:
+                    acked = []
+                acked.append(req_id)
+            elif now >= entry[2]:
+                wait_s, entry[3] = self._retry_delay(entry[3])
+                entry[2] = now + wait_s
+                self.send_async(P.SUBMIT_TASKS, entry[1])
+        if acked is not None:
+            for req_id in acked:
+                self._unacked_bulk.pop(req_id, None)
 
     def _read_loop(self) -> None:
         try:
@@ -327,9 +427,33 @@ class CoreClient:
                     raise EOFError("connection closed during recv")
                 msg_type, payload = loads_frame(blob)
                 if msg_type == "batch":
-                    # hub reactor coalesces its per-peer sends (hub._send)
+                    # hub reactor coalesces its per-peer sends (hub._send):
+                    # one loads_frame already covered the whole batch.
+                    # Hoist the table load out of the inner loop and
+                    # memoize the handler across runs of one msg_type
+                    # (bulk replies arrive as long same-type runs), and
+                    # fold every READY_PUSH in the frame into a single
+                    # vector apply — one cache-lock acquisition and one
+                    # event set per frame instead of per message.
+                    handlers = self._inbound_handlers
+                    put = self.task_queue.put
+                    ready_ids = None
+                    last_mt = None
+                    h = None
                     for mt, pl in payload:
-                        self._dispatch_inbound(mt, pl)
+                        if mt != last_mt:
+                            last_mt = mt
+                            h = handlers.get(mt)
+                        if mt == P.READY_PUSH:
+                            if ready_ids is None:
+                                ready_ids = []
+                            ready_ids.extend(pl.get("ready", ()))
+                        elif h is not None:
+                            h(pl)
+                        else:
+                            put((mt, pl))
+                    if ready_ids is not None:
+                        self._apply_ready(ready_ids)
                     continue
                 self._dispatch_inbound(msg_type, payload)
         except (EOFError, OSError):
@@ -389,10 +513,17 @@ class CoreClient:
     def _on_ready_push(self, payload) -> None:
         """Runs on the reader thread: the hub pushed a batch of
         newly-ready object ids (readiness subscription, _wait_push)."""
+        self._apply_ready(payload.get("ready", ()))
+
+    def _apply_ready(self, ids) -> None:
+        """Mark a vector of object ids ready (reader thread). The
+        batch-decode path in _read_loop funnels every READY_PUSH of a
+        frame through one call, so a bulk submit's completion storm
+        costs one lock round trip instead of one per push."""
         with self._obj_cache_lock:
             known = self._known_ready
             subscribed = self._ready_subscribed
-            for b in payload.get("ready", ()):
+            for b in ids:
                 known[b] = True
                 subscribed.discard(b)
             while len(known) > 65536:
@@ -485,6 +616,17 @@ class CoreClient:
     # the base delay; doubles per resend up to _RETRY_MAX_S.
     _RETRY_PERIOD_S = 2.0
     _RETRY_MAX_S = 30.0
+
+    # adaptive-coalescing bounds (send_async): the window floor keeps
+    # per-message overhead amortized at least 16-way under sustained
+    # load; the ceiling bounds burst latency and frame size; the byte
+    # cap flushes early when large payloads (put_value) stack up
+    _COALESCE_FLOOR = 16
+    _COALESCE_CEIL = 512
+    _COALESCE_MAX_BYTES = 1 << 20
+
+    # process-wide client generation counter (see self.client_epoch)
+    _EPOCH_COUNTER = itertools.count(1)
 
     def _retry_delay(self, delay: float,
                      cap: Optional[float] = None) -> Tuple[float, float]:
@@ -629,7 +771,12 @@ class CoreClient:
         tr = self._trace_begin() if self._tracing_live() else None
         if tr is None:
             kind, payload, size = self.encode_value(oid, obj)
-            self.send_async(P.PUT, {"object_id": oid.binary(), "kind": kind, "payload": payload, "size": size})
+            self.send_async(
+                P.PUT,
+                {"object_id": oid.binary(), "kind": kind,
+                 "payload": payload, "size": size},
+                cost=size if kind == P.VAL_INLINE else 0,
+            )
         else:
             t0 = time.monotonic()  # the put span covers the encode too
             kind, payload, size = self.encode_value(oid, obj)
@@ -1310,6 +1457,78 @@ class CoreClient:
         if return_task_id:
             return task_id.binary(), return_ids
         return return_ids
+
+    def submit_many(
+        self,
+        fn_id: str,
+        encoded: List[tuple],
+        num_returns: int,
+        resources: Dict[str, float],
+        options: dict,
+    ) -> Tuple[List[bytes], List[List[bytes]]]:
+        """Ship N homogeneous tasks in ONE P.SUBMIT_TASKS wire frame
+        (RemoteFunction.map). ``encoded`` is [(args_kind, args_payload,
+        arg_dep_ids), ...]; fn_id/resources/options are shared by every
+        task and travel once in the outer payload. All task and return
+        ids are drawn in one slab from the entropy pool. Returns
+        (task_ids, return_ids_per_task) as raw bytes.
+
+        Delivery: the hub acks the batch via REPLY(req_id); an unacked
+        batch is retransmitted by the flusher (_scan_unacked) and
+        deduplicated per task on the hub, so a chaos-dropped frame
+        loses nothing. With retransmit disabled (period <= 0) the send
+        is fire-and-forget like submit_task."""
+        n = len(encoded)
+        self._stamp_job(options)
+        slab = id_slab(n * (1 + num_returns))
+        task_ids = slab[:n]
+        rid_rows = [
+            slab[n + i * num_returns: n + (i + 1) * num_returns]
+            for i in range(n)
+        ]
+        payload = {
+            "fn_id": fn_id,
+            "resources": resources,
+            "options": options,
+            "tasks": [
+                {
+                    "task_id": task_ids[i],
+                    "args_kind": e[0],
+                    "args_payload": e[1],
+                    "arg_deps": e[2],
+                    "return_ids": rid_rows[i],
+                }
+                for i, e in enumerate(encoded)
+            ],
+        }
+        if self._RETRY_PERIOD_S > 0:
+            req_id = next(self._req_counter)
+            payload["req_id"] = req_id
+            fut: Future = Future()
+            with self._pending_lock:
+                self._pending[req_id] = fut
+            wait_s, nxt = self._retry_delay(self._RETRY_PERIOD_S)
+            while len(self._unacked_bulk) >= 256:
+                # FIFO bound: an evicted entry just loses retransmit
+                # coverage; its ack (if it comes) still resolves the
+                # pending future and is dropped there
+                self._unacked_bulk.pop(
+                    next(iter(self._unacked_bulk)), None)
+            self._unacked_bulk[req_id] = [
+                fut, payload, time.monotonic() + wait_s, nxt,
+            ]
+        tr = self._trace_begin() if self._tracing_live() else None
+        if tr is None:
+            self.send_async(P.SUBMIT_TASKS, payload)
+        else:
+            # ONE client.submit span for the whole batch; the hub fans
+            # it out to N hub.admit children (_on_submit_tasks)
+            self._traced_send(
+                P.SUBMIT_TASKS, payload, "client.submit", "submit", tr,
+                remember_ids=[r for row in rid_rows for r in row],
+                fn_id=fn_id, n=n,
+            )
+        return task_ids, rid_rows
 
     def create_actor(
         self,

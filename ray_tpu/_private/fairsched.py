@@ -302,6 +302,51 @@ class FairScheduler:
         self._charge_admission(t, spec)
         return True
 
+    def admit_many(self, specs) -> List[bool]:
+        """One admission fold for a homogeneous batch (bulk submit:
+        same fn, same resources, same tenant/options shape). Verdict
+        per spec, same semantics as admit() called in order — but the
+        inert-case check, owner assert, tenant lookup, and the
+        infeasibility screen run ONCE per batch instead of once per
+        task. Parking stays FIFO: the first spec that doesn't fit
+        parks, and everything after it parks behind it."""
+        if not self.tenants or not specs:
+            return [True] * len(specs)
+        self._assert_owner()
+        head = specs[0]
+        tenant_name = self.tenant_of(head.options)
+        t = self.tenants.get(tenant_name)
+        if t is not None and t.quota:
+            # homogeneous resources: one infeasible spec means the
+            # whole batch can never run — fail it in one raise
+            infeasible = {
+                k: cap for k, cap in t.quota.items()
+                if head.resources.get(k, 0.0) > cap + 1e-9
+            }
+            if infeasible:
+                raise QuotaInfeasibleError(
+                    f"task requires {head.resources} but tenant "
+                    f"'{tenant_name}' quota caps {infeasible} — it can "
+                    "never be admitted; shrink the request or raise "
+                    "the quota"
+                )
+        out: List[bool] = []
+        for spec in specs:
+            if spec.task_id in self._admitted:
+                out.append(True)
+                continue
+            self._note_submit(spec.options)
+            if (t is None or not t.quota
+                    or spec.options.get("placement_group")):
+                out.append(True)
+            elif t.parked or not self._fits_quota(t, spec.resources):
+                t.parked.append(spec)
+                out.append(False)
+            else:
+                self._charge_admission(t, spec)
+                out.append(True)
+        return out
+
     def charge_reservation(
         self,
         key: bytes,

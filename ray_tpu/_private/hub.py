@@ -166,6 +166,12 @@ class TaskSpec:
     # submit was head-sampled (util/tracing.py). None = untraced — every
     # span-emission site gates on it, so the default path adds nothing.
     trace: Optional[tuple] = None
+    # submitted through the bulk SUBMIT_TASKS frame (RemoteFunction.map):
+    # the caller declared a homogeneous throughput-oriented fan-out, so
+    # the scheduler may pipeline it behind busy workers. Individually
+    # submitted tasks keep strict work-stealing placement (lowest
+    # latency to first execution) and never pipeline.
+    bulk: bool = False
 
 
 @dataclass
@@ -185,7 +191,13 @@ class WorkerEntry:
     # WITHOUT burning the retry/restart budget
     preempted: bool = False
     state: str = "starting"  # starting | idle | busy | actor | dead
-    current_task: Optional[TaskSpec] = None
+    # dispatch pipeline: FIFO of tasks assigned to this worker. The head
+    # is executing; followers sit in the worker process's own task queue
+    # (it drains sequentially), so TASK_DONE/EXEC frames coalesce instead
+    # of paying a wake+syscall round-trip per task. Plain tasks only —
+    # see _find_pipeline_worker for the eligibility gate.
+    assigned: deque = field(default_factory=deque)
+    pipe_ok: bool = False  # every task in `assigned` is pipeline-eligible
     actor_id: Optional[bytes] = None
     seen_fns: Set[str] = field(default_factory=set)
     tpu_chips: Tuple[int, ...] = ()  # chips assigned to the current task
@@ -203,6 +215,20 @@ class WorkerEntry:
     # timeout timer armed for attempt N can never kill attempt N+1 of
     # the SAME (retried, hence identical) TaskSpec on this worker
     exec_gen: int = 0
+
+    # `current_task` predates the pipeline: it is now a view of the
+    # assigned queue's head. The setter keeps the single-assignment
+    # call sites working — assigning replaces the whole queue. (Not an
+    # annotated attribute, so the dataclass machinery ignores it.)
+    @property
+    def current_task(self) -> Optional[TaskSpec]:
+        return self.assigned[0] if self.assigned else None
+
+    @current_task.setter
+    def current_task(self, spec: Optional[TaskSpec]) -> None:
+        self.assigned.clear()
+        if spec is not None:
+            self.assigned.append(spec)
 
 
 @dataclass
@@ -2768,7 +2794,86 @@ class Hub:
             task_id=spec.task_id.hex(),
         )
 
-    def _admit(self, spec: TaskSpec, deps: List[bytes]):
+    def _on_submit_tasks(self, conn, p):
+        """Bulk admission: N homogeneous tasks from ONE wire frame
+        (client.submit_many / RemoteFunction.map). Shared fields
+        (fn_id/resources/options) are hoisted into the outer payload;
+        the batch is admitted in one pass — one fairsched fold over
+        the deps-clear specs, one dedup-index insert per task, and a
+        SINGLE scheduler wake at the end instead of N. Per-conn FIFO
+        holds: tasks enter the runnable queues in list order, exactly
+        as N sequential SUBMIT_TASKs would."""
+        fn_id = p["fn_id"]
+        resources = p["resources"]
+        base_opts = p["options"]
+        retries = base_opts.get("max_retries", 3)
+        tr = p.get("trace")
+        t0 = time.monotonic()
+        fresh: List[TaskSpec] = []
+        for t in p["tasks"]:
+            if t["task_id"] in self._task_event_index:
+                # replayed batch (retransmit after a lost ack) or chaos
+                # dup: every already-seen task is pending/running/done
+                continue
+            spec = TaskSpec(
+                task_id=t["task_id"],
+                fn_id=fn_id,
+                args_kind=t["args_kind"],
+                args_payload=t["args_payload"],
+                return_ids=t["return_ids"],
+                resources=resources,
+                # per-task copy: fairsched stamps _fs_counted and the
+                # scheduler mutates options in place — sharing the
+                # frame's dict across specs would cross-contaminate
+                options=dict(base_opts),
+                retries_left=retries,
+                bulk=True,
+            )
+            if tr is not None:
+                spec.trace = (tr[0], tr[1])
+            self._admit(spec, t["arg_deps"], enqueue=False)
+            if spec.deps_remaining == 0:
+                fresh.append(spec)
+        if fresh:
+            try:
+                verdicts = self.fairsched.admit_many(fresh)
+            except QuotaInfeasibleError as err:
+                for spec in fresh:
+                    self.tasks[spec.task_id] = spec
+                    self._fail_task(spec, ValueError(str(err)))
+                verdicts = None
+            if verdicts is not None:
+                parked = False
+                for spec, ok in zip(fresh, verdicts):
+                    if ok:
+                        self._enqueue_ready(spec, dispatch=False)
+                    else:
+                        self.tasks[spec.task_id] = spec
+                        self._task_event(spec.task_id,
+                                         state="PENDING_QUOTA")
+                        parked = True
+                if parked:
+                    self._refresh_pending_quota_gauge()
+        if tr is not None:
+            # one client.submit span fans out to N hub.admit children;
+            # each child gets a 1/N slice of the admission window so
+            # the per-stage durations still partition wall time
+            t1 = time.monotonic()
+            n = max(len(p["tasks"]), 1)
+            dt = (t1 - t0) / n
+            for i, t in enumerate(p["tasks"]):
+                self._emit_runtime_span(
+                    "hub.admit", "admit", (tr[0], tr[1]),
+                    t0 + i * dt, t0 + (i + 1) * dt,
+                    task_id=t["task_id"].hex(),
+                )
+        req_id = p.get("req_id")
+        if req_id is not None:
+            self._reply(conn, req_id, ok=True, admitted=len(fresh))
+        self._dispatch()
+
+    def _admit(self, spec: TaskSpec, deps: List[bytes],
+               enqueue: bool = True):
         pending = 0
         for dep in deps:
             e = self.objects.get(dep)
@@ -2794,7 +2899,7 @@ class Hub:
             # the trace id rides the task event so flight-recorder
             # entries (retry/fail/preempt) and the timeline cross-link
             ev["trace_id"] = spec.trace[0]
-        if pending == 0:
+        if pending == 0 and enqueue:
             self._enqueue_runnable(spec)
 
     def _sched_class(self, spec: TaskSpec) -> tuple:
@@ -2939,7 +3044,7 @@ class Hub:
         for key, q in classes:
             while q:
                 self._last_spawn_node = None
-                placed = self._try_place(q[0])
+                placed = self._try_place(q[0], qlen=len(q))
                 if placed in ("placed", "failed"):
                     q.popleft()
                 else:
@@ -3012,7 +3117,63 @@ class Hub:
                     self._spawn_worker(node, runtime_env=renv,
                                        renv_hash=renv_hash)
 
-    def _try_place(self, spec: TaskSpec) -> str:
+    # ----- dispatch pipelining: when the pool is saturated and the
+    # backlog is deep, plain tasks queue directly behind busy workers
+    # (bounded depth) instead of waiting for an idle one. The worker's
+    # own task queue serializes execution, its _send_done coalesces the
+    # TASK_DONE replies, and the hub outbox batches the EXEC frames —
+    # on a syscall-bound box this is the difference between one wire
+    # round-trip per task and one per DEPTH tasks.
+    _PIPE_DEPTH = 16  # head + followers a worker may hold
+    # engage only under a real backlog: short queues keep strict
+    # one-task-per-worker placement (no follower can strand behind a
+    # slow head; latency-sensitive interactive submits are unaffected)
+    _PIPE_MIN_QUEUE = 16
+
+    def _pipeline_ok(self, spec: TaskSpec) -> bool:
+        """Only plain tasks pipeline: no actors (worker becomes the
+        actor), no TPU (chip assignment is per-dispatch), no streaming
+        (backpressure credits assume one producer per worker), no
+        execute deadline (the timer would count worker-queue wait), no
+        placement group (bundle accounting is head-only). Only BULK
+        submissions (RemoteFunction.map) opt in at all — the caller
+        declared a throughput-oriented fan-out; individually submitted
+        tasks keep strict one-task-per-worker work-stealing."""
+        o = spec.options
+        return (
+            spec.bulk
+            and not spec.is_actor_create
+            and spec.actor_id is None
+            and not spec.resources.get("TPU", 0)
+            and not o.get("streaming")
+            and not o.get("timeout_s")
+            and not o.get("placement_group")
+            and not self.config.task_timeout_default_s
+        )
+
+    def _find_pipeline_worker(self, spec: TaskSpec, nodes) -> Optional[WorkerEntry]:
+        """Least-loaded busy worker that can take `spec` as a follower:
+        same runtime env, head holding an IDENTICAL resource dict (the
+        promotion in _on_task_done swaps head resources exactly), every
+        assigned task pipeline-eligible, and depth headroom."""
+        allowed = {n.node_id for n in nodes}
+        need_env = spec.options.get("runtime_env_hash", "")
+        best = None
+        for w in self.workers.values():
+            if (
+                w.state != "busy" or not w.pipe_ok or not w.assigned
+                or w.actor_id is not None
+                or w.node_id not in allowed
+                or w.runtime_env_hash != need_env
+                or len(w.assigned) >= self._PIPE_DEPTH
+                or w.assigned[0].resources != spec.resources
+            ):
+                continue
+            if best is None or len(w.assigned) < len(best.assigned):
+                best = w
+        return best
+
+    def _try_place(self, spec: TaskSpec, qlen: int = 1) -> str:
         pools = self._effective_pools(spec)
         if pools is None:
             self._fail_task(spec, ValueError("placement group was removed"))
@@ -3046,6 +3207,17 @@ class Hub:
                 if self._resources_fit(spec.resources, n.avail)
             ]
             if not candidates:
+                # node resources exhausted (every unit held by a running
+                # task): the only way forward without pipelining is to
+                # wait for a TASK_DONE. Queue behind a busy worker when
+                # the backlog justifies it — the follower acquires the
+                # head's resources at promotion, so accounting stays
+                # exact and nothing oversubscribes.
+                if qlen >= self._PIPE_MIN_QUEUE and self._pipeline_ok(spec):
+                    w = self._find_pipeline_worker(spec, allowed)
+                    if w is not None:
+                        self._send_exec(w, spec, (), pipelined=True)
+                        return "placed"
                 return "defer"
         for node, avail in candidates:
             worker, chips = self._find_idle_worker(
@@ -3167,10 +3339,20 @@ class Hub:
                 best = w
         return best, ()
 
-    def _send_exec(self, worker: WorkerEntry, spec: TaskSpec, chips: Tuple[int, ...]):
+    def _send_exec(self, worker: WorkerEntry, spec: TaskSpec,
+                   chips: Tuple[int, ...], pipelined: bool = False):
         worker.state = "busy"
-        worker.current_task = spec
-        worker.tpu_chips = chips
+        if pipelined:
+            # follower: queue behind the executing head. The worker
+            # process drains its task queue sequentially, and its
+            # _send_done batches TASK_DONEs whenever more work is
+            # queued — this is what turns a deep backlog into few
+            # frames instead of a wake+syscall round-trip per task.
+            worker.assigned.append(spec)
+        else:
+            worker.current_task = spec
+            worker.tpu_chips = chips
+            worker.pipe_ok = self._pipeline_ok(spec)
         now_mono = time.monotonic()
         ev = self._task_event(
             spec.task_id, state="RUNNING", started_at=time.time(),
@@ -3246,7 +3428,10 @@ class Hub:
         timeout_s = spec.options.get("timeout_s") or (
             self.config.task_timeout_default_s
         )
-        if timeout_s and timeout_s > 0:
+        # pipelined specs never reach here with a deadline
+        # (_pipeline_ok excludes them): a timer armed at queue-behind
+        # time would count worker-queue wait against the execute budget
+        if timeout_s and timeout_s > 0 and not pipelined:
             worker.exec_gen = gen = next(self._exec_seq)
             self._add_timer(
                 float(timeout_s),
@@ -3447,9 +3632,24 @@ class Hub:
             # NEW task on it — must not reset the worker under that
             # task (which would double-book it and disarm its
             # exec-timeout guard)
-            worker.state = "idle"
-            worker.current_task = None
-            worker.tpu_chips = ()  # chips stay pinned to the worker (affinity)
+            worker.assigned.popleft()
+            if worker.assigned:
+                # pipelined follower promotes to head: it takes over the
+                # node resources the finished head releases just below
+                # (same scheduling class ⇒ identical resource dict), so
+                # the swap is exact — avail dips negative for the few
+                # lines until _release_task_resources restores it, with
+                # no reader in between. _pool presence is the
+                # "resources acquired" marker release keys off.
+                nh = worker.assigned[0]
+                if "_pool" not in nh.options:
+                    node = self.nodes.get(worker.node_id)
+                    if node is not None:
+                        self._acquire(nh.resources, node.avail)
+                        nh.options["_pool"] = ("node", worker.node_id, None)
+            else:
+                worker.state = "idle"
+                worker.tpu_chips = ()  # chips stay pinned to the worker (affinity)
         if spec is not None:
             self._release_task_resources(spec)
             if spec.actor_id is not None:
@@ -4164,6 +4364,23 @@ class Hub:
                 self._enqueue_runnable(spec)
             else:
                 self._fail_task(spec, WorkerCrashedError("worker died while executing task"))
+        if len(worker.assigned) > 1:
+            # pipelined followers never started executing: requeue them
+            # WITHOUT burning the crash-retry budget (only the head was
+            # running). They hold no node resources until promotion, so
+            # _release_task_resources only settles their fairshare clock.
+            followers = list(worker.assigned)[1:]
+            worker.assigned.clear()
+            if spec is not None:
+                worker.assigned.append(spec)  # head: handled above
+            self._record_event(
+                "pipeline_requeue", worker_id=worker.worker_id,
+                count=len(followers),
+            )
+            for f in followers:
+                self._release_task_resources(f)
+                self._task_event(f.task_id, state="PENDING_RETRY")
+                self._enqueue_runnable(f)
         if worker.actor_id or (spec is not None and spec.is_actor_create):
             actor_id = worker.actor_id or spec.actor_id
             actor = self.actors.get(actor_id)
@@ -4279,6 +4496,21 @@ class Hub:
                         self._send(worker.conn, P.CANCEL_TASK,
                                    {"task_id": spec.task_id,
                                     "return_ids": spec.return_ids})
+                    return
+        # pipelined followers queued in a worker's own task queue: drop
+        # at dequeue (CANCEL_TASK marks it worker-side) and fail here —
+        # they never started, hold no node resources, and need no
+        # interrupt
+        for w in self.workers.values():
+            for spec in list(w.assigned)[1:]:
+                if oid in spec.return_ids:
+                    w.assigned.remove(spec)
+                    if w.conn is not None:
+                        self._send(w.conn, P.CANCEL_TASK,
+                                   {"task_id": spec.task_id,
+                                    "return_ids": spec.return_ids})
+                    self.tasks.pop(spec.task_id, None)
+                    self._fail_task(spec, TaskCancelledError("task was cancelled"))
                     return
         # running task: interrupt its worker
         for w in self.workers.values():

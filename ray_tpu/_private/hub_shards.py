@@ -67,7 +67,8 @@ from .serialization import dumps_frame, loads_frame
 # unknown message type defaults to the scheduler service (matching the
 # monolithic hub, where unknown types are dropped by the handler table).
 SCHEDULER_MSGS = frozenset({
-    "hello", "submit_task", "task_done", "create_actor", "actor_ready",
+    "hello", "submit_task", "submit_tasks", "task_done", "create_actor",
+    "actor_ready",
     "submit_actor_task", "kill_actor", "cancel", "create_pg", "remove_pg",
     "pg_ready", "get_actor", "register_job", "register_node",
     "worker_exited", "node_heartbeat", "register_function", "get_function",

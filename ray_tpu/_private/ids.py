@@ -43,6 +43,31 @@ def _pooled_id_bytes() -> bytes:
     return buf[pos:pos + _ID_LEN]
 
 
+def id_slab(n: int) -> list:
+    """``n`` raw id byte strings in one draw. A bulk submit needs
+    N task ids + N*num_returns object ids up front; drawing them one
+    at a time costs a pool-bookkeeping round per id and, every 1024
+    ids, a syscall mid-loop. One sized urandom (plus whatever is left
+    in the thread pool) amortizes both across the slab."""
+    buf = getattr(_entropy, "buf", None)
+    pos = getattr(_entropy, "pos", 0)
+    if buf is None:
+        buf, pos = b"", 0
+    avail = (len(buf) - pos) // _ID_LEN
+    out = [buf[pos + i * _ID_LEN: pos + (i + 1) * _ID_LEN]
+           for i in range(min(n, avail))]
+    _entropy.pos = pos + len(out) * _ID_LEN
+    if len(out) < n:
+        need = n - len(out)
+        # refill covers the remainder AND leaves a full pool behind
+        fresh = os.urandom(_ID_LEN * (need + _ID_POOL_IDS))
+        out.extend(fresh[i * _ID_LEN: (i + 1) * _ID_LEN]
+                   for i in range(need))
+        _entropy.buf = fresh
+        _entropy.pos = need * _ID_LEN
+    return out
+
+
 def span_id_hex() -> str:
     """16-hex-char tracing span/trace id from the same pooled entropy
     (util/tracing.py): span open is a hot path when runtime sampling is

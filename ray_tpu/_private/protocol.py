@@ -26,6 +26,16 @@ one ("batch", [(msg_type, payload), ...]) frame by either side
 # client -> hub
 HELLO = "hello"
 SUBMIT_TASK = "submit_task"
+SUBMIT_TASKS = "submit_tasks"  # N homogeneous tasks in ONE frame
+                               # (RemoteFunction.map / submit_many):
+                               # {fn_id, resources, options, tasks:
+                               # [{task_id, args_kind, args_payload,
+                               # arg_deps, return_ids}, ...], req_id}.
+                               # The shared fields are hoisted out of
+                               # the per-task dicts; the hub acks via
+                               # REPLY(req_id) so the client can
+                               # retransmit a dropped batch (per-task
+                               # dedup on task_id makes replay safe)
 PUT = "put"
 GET = "get"
 WAIT = "wait"

@@ -362,9 +362,14 @@ class StreamingExecutor:
         in_flight: deque = deque()  # submission order == output order
         cap = self.ctx.max_tasks_in_flight
         while pending or in_flight:
-            while pending and len(in_flight) < cap:
+            batch = []
+            while pending and len(in_flight) + len(batch) < cap:
                 rt = pending.popleft()
-                in_flight.append(remote.remote(rt.read_fn, plain_chain))
+                batch.append((rt.read_fn, plain_chain))
+            if batch:
+                # one SUBMIT_TASKS frame per window refill, not one
+                # frame per read task
+                in_flight.extend(remote.map(batch))
             yield from self._flatten_refs(in_flight.popleft())
 
     def _run_map(self, stage: _MapStage, upstream: Iterator[Any]) -> Iterator[Any]:
@@ -389,13 +394,17 @@ class StreamingExecutor:
         upstream_done = False
         up = upstream
         while not upstream_done or in_flight:
-            while not upstream_done and len(in_flight) < cap:
+            batch = []
+            while not upstream_done and len(in_flight) + len(batch) < cap:
                 try:
                     block_ref = next(up)
                 except StopIteration:
                     upstream_done = True
                     break
-                in_flight.append(remote.remote(plain_chain, block_ref))
+                batch.append((plain_chain, block_ref))
+            if batch:
+                # whole window refill rides one SUBMIT_TASKS frame
+                in_flight.extend(remote.map(batch))
             if not in_flight:
                 continue
             yield from self._flatten_refs(in_flight.popleft())

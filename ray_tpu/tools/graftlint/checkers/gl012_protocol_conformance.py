@@ -42,7 +42,12 @@ Findings:
    as optional);
 6. *payload key never read* — a key every send site includes that no
    handler ever reads (dead wire weight), checked only when every
-   handler's payload use is fully visible (no escapes/iteration).
+   handler's payload use is fully visible (no escapes/iteration);
+7. *required item key missing* — vector payloads (bulk frames like
+   ``SUBMIT_TASKS`` carrying ``tasks: [{...}, ...]``): a handler that
+   loops ``for t in payload[k]`` and subscripts ``t["x"]``
+   unconditionally requires ``x`` on EVERY item; a send site building
+   the item list from tracked dict literals must include it.
 
 The pass is inert in sessions without a ``protocol.py`` (single-file
 fixture runs of other rules), so per-file checks stay per-file.
@@ -159,6 +164,27 @@ def check(session: ProjectSession) -> List[Finding]:
         for h in hs:
             for k in h.required_keys:
                 required.setdefault(k, h)
+        # ---- 7. vector payloads: per-item required keys
+        item_required: Dict[object, object] = {}
+        for h in hs:
+            for pk, iks in h.item_required.items():
+                for ik in iks:
+                    item_required.setdefault((pk, ik), h)
+        for s in ss:
+            for (pk, ik), h in sorted(item_required.items()):
+                iks = s.item_keys.get(pk)
+                if iks is None or ik in iks:
+                    # untracked item list = opaque (no claim either way)
+                    continue
+                out.append(_f(
+                    s.module.path, s.line,
+                    f"send site for {msg!r} builds {pk!r} items without "
+                    f"key {ik!r} which {h.symbol} reads unconditionally "
+                    f"on every item (for t in payload[{pk!r}]: "
+                    f"t[{ik!r}]) — this send would KeyError in the "
+                    f"handler",
+                    f"{s.symbol}.{msg}.{pk}[].{ik}.missing",
+                ))
         for s in ss:
             if s.keys is None:
                 continue

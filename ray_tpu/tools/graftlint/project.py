@@ -125,6 +125,12 @@ class SendSite:
     keys: Optional[FrozenSet[str]]
     via: str                            # the send API spelling used
     raw_string: bool                    # msg given as a bare string literal
+    # vector payloads (bulk frames like SUBMIT_TASKS): payload key ->
+    # keys of the homogeneous dict items under it, when the value is a
+    # tracked list-of-dict-literals ([{...}, ...] or [{...} for ...]).
+    # Only keys EVERY item carries are recorded, so handler-side
+    # per-item required reads can be checked against them.
+    item_keys: Dict[str, FrozenSet[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -138,6 +144,12 @@ class Handler:
     opaque: bool                        # payload escapes / is iterated: the
                                         # read set is a lower bound only
     raw_string: bool
+    # vector payloads: payload key -> item keys the handler reads on
+    # EVERY element of ``for t in payload[k]:`` loops (plain subscript,
+    # unconditional within the loop body). item_read is every item key
+    # read in any way (.get included).
+    item_required: Dict[str, FrozenSet[str]] = field(default_factory=dict)
+    item_read: Dict[str, FrozenSet[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -444,6 +456,73 @@ def _literal_dict_keys(node: ast.AST) -> Optional[Set[str]]:
     return keys
 
 
+def _item_literal_keys(node: ast.AST) -> Optional[FrozenSet[str]]:
+    """Item keys when ``node`` builds a list of dict literals — a
+    ``[{...}, ...]`` literal or a ``[{...} for ...]`` comprehension.
+    Only keys every element carries count (intersection), so a handler
+    relying on one is guaranteed it on each item. None = not a tracked
+    vector value."""
+    if isinstance(node, ast.List) and node.elts:
+        elts = node.elts
+    elif isinstance(node, ast.ListComp):
+        elts = [node.elt]
+    else:
+        return None
+    keys: Optional[Set[str]] = None
+    for e in elts:
+        k = _literal_dict_keys(e)
+        if k is None:
+            return None
+        keys = set(k) if keys is None else keys & k
+    return frozenset(keys) if keys else None
+
+
+def _tracked_item_keys(fn: ast.AST, call: ast.Call,
+                       payload_node: ast.AST) -> Dict[str, FrozenSet[str]]:
+    """Vector values inside a send payload: payload key -> item keys,
+    for every payload entry whose value is a tracked list-of-dicts.
+    Covers the same payload shapes _tracked_payload_keys follows — a
+    dict literal at the call, or a local dict augmented by
+    ``var["k"] = [...]`` before the send."""
+    out: Dict[str, FrozenSet[str]] = {}
+
+    def harvest_dict(d: ast.AST) -> None:
+        if not isinstance(d, ast.Dict):
+            return
+        for k, v in zip(d.keys, d.values):
+            s = k is not None and _const_str(k)
+            if not s:
+                continue
+            iks = _item_literal_keys(v)
+            if iks is not None:
+                out[s] = iks
+
+    harvest_dict(payload_node)
+    if not isinstance(payload_node, ast.Name):
+        return out
+    name = payload_node.id
+    for node in ast.walk(fn):
+        line = getattr(node, "lineno", None)
+        if line is None or line > call.lineno:
+            continue
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                harvest_dict(node.value)
+            elif (
+                isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == name
+            ):
+                s = _const_str(t.slice)
+                if s:
+                    iks = _item_literal_keys(node.value)
+                    if iks is not None:
+                        out[s] = iks
+    return out
+
+
 def _tracked_payload_keys(fn: ast.AST, call: ast.Call,
                           payload_node: ast.AST,
                           depth: int = 0) -> Optional[Set[str]]:
@@ -599,8 +678,10 @@ def _find_sends(session: ProjectSession, mod: ModuleInfo,
             if msg is not None:
                 msgs = [(msg, raw)]
             keys = None
+            item_keys: Dict[str, FrozenSet[str]] = {}
             if msgs and payload_node is not None:
                 keys = _tracked_payload_keys(fn, node, payload_node)
+                item_keys = _tracked_item_keys(fn, node, payload_node)
                 if keys is not None and api == "request":
                     # CoreClient.request() stamps the req_id itself
                     # (payload = dict(payload, req_id=req_id))
@@ -611,7 +692,7 @@ def _find_sends(session: ProjectSession, mod: ModuleInfo,
                 out.append(SendSite(
                     module=mod, line=node.lineno, msg=m, symbol=qual,
                     keys=frozenset(keys) if keys is not None else None,
-                    via=api, raw_string=r,
+                    via=api, raw_string=r, item_keys=item_keys,
                 ))
     return out
 
@@ -686,6 +767,9 @@ class _PayloadReads:
         self.required: Set[str] = set()
         self.read: Set[str] = set()
         self.opaque = False
+        # vector payloads: payload key -> reads of the loop variable of
+        # a ``for t in payload[k]:`` loop (t["x"] per-item subscripts)
+        self.item: Dict[str, "_PayloadReads"] = {}
 
 
 def _collect_payload_reads(
@@ -777,6 +861,12 @@ def _collect_payload_reads(
                 acc.opaque = acc.opaque or sub.opaque
                 if id(node) not in cond:
                     acc.required |= sub.required
+                for pk, sv in sub.item.items():
+                    dst = acc.item.setdefault(pk, _PayloadReads())
+                    dst.read |= sv.read
+                    dst.opaque = dst.opaque or sv.opaque
+                    if id(node) not in cond:
+                        dst.required |= sv.required
             elif (
                 isinstance(node, ast.Compare)
                 and len(node.ops) == 1
@@ -788,6 +878,30 @@ def _collect_payload_reads(
                 if k:
                     acc.read.add(k)
             elif isinstance(node, (ast.Assign, ast.Return, ast.For)):
+                # ``for t in payload["k"]:`` — a vector read: collect
+                # the loop variable's per-item subscripts so bulk-frame
+                # senders can be checked against them (the subscript on
+                # payload itself already registered "k" as a read above)
+                if (
+                    isinstance(node, ast.For)
+                    and isinstance(node.iter, ast.Subscript)
+                    and isinstance(node.iter.value, ast.Name)
+                    and node.iter.value.id == payload_name
+                    and isinstance(node.target, ast.Name)
+                ):
+                    pk = _const_str(node.iter.slice)
+                    if pk:
+                        sub = acc.item.setdefault(pk, _PayloadReads())
+                        got = _PayloadReads()
+                        _collect_payload_reads(
+                            mod, methods, list(node.body), node.target.id,
+                            got, visited, depth + 1,
+                        )
+                        sub.read |= got.read
+                        sub.opaque = sub.opaque or got.opaque
+                        if id(node) not in cond:
+                            sub.required |= got.required
+                        continue
                 # payload stored, returned, or iterated: escapes
                 vals = []
                 if isinstance(node, ast.Assign):
@@ -826,6 +940,10 @@ def _handler_from_method(mod: ModuleInfo, cls: ast.ClassDef,
         read_keys=frozenset(acc.read),
         opaque=acc.opaque or payload_name is None,
         raw_string=raw,
+        item_required={k: frozenset(v.required)
+                       for k, v in acc.item.items() if v.required},
+        item_read={k: frozenset(v.read)
+                   for k, v in acc.item.items() if v.read},
     )
 
 
@@ -928,6 +1046,10 @@ def _elif_chain(session: ProjectSession, mod: ModuleInfo,
             read_keys=frozenset(acc.read),
             opaque=acc.opaque or payload_name is None,
             raw_string=raw,
+            item_required={k: frozenset(v.required)
+                           for k, v in acc.item.items() if v.required},
+            item_read={k: frozenset(v.read)
+                       for k, v in acc.item.items() if v.read},
         ))
     table = DispatchTable(
         module=mod, line=fn.lineno, kind="elif", owner=qual,

@@ -68,15 +68,32 @@ class ActorPool:
             fn, value = self._pending_submits.pop(0)
             self.submit(fn, value)
 
+    @staticmethod
+    def _submit_window():
+        """Batched-send window for the submit burst: actor tasks can't
+        share one SUBMIT_TASKS frame (each targets a different actor),
+        but holding the client's count-based flush for the burst packs
+        them into minimal wire frames."""
+        from .._private import worker
+
+        client = getattr(worker, "_client", None)
+        if client is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return client.batch_window()
+
     def map(self, fn: Callable, values: Iterable[Any]):
-        for v in values:
-            self.submit(fn, v)
+        with self._submit_window():
+            for v in values:
+                self.submit(fn, v)
         while self.has_next():
             yield self.get_next()
 
     def map_unordered(self, fn: Callable, values: Iterable[Any]):
-        for v in values:
-            self.submit(fn, v)
+        with self._submit_window():
+            for v in values:
+                self.submit(fn, v)
         while self.has_next():
             yield self.get_next_unordered()
 

@@ -82,12 +82,14 @@ class Pool:
     def map_async(self, func: Callable, iterable: Iterable) -> AsyncResult:
         self._check_open()
         rf = self._remote(func)
-        return AsyncResult([rf.remote(x) for x in iterable], single=False)
+        # whole input in one SUBMIT_TASKS frame; (x,) keeps single-arg
+        # semantics even when x is itself a tuple
+        return AsyncResult(rf.map([(x,) for x in iterable]), single=False)
 
     def starmap_async(self, func: Callable, iterable: Iterable) -> AsyncResult:
         self._check_open()
         rf = self._remote(func)
-        return AsyncResult([rf.remote(*x) for x in iterable], single=False)
+        return AsyncResult(rf.map([tuple(x) for x in iterable]), single=False)
 
     def apply_async(self, func: Callable, args: tuple = (),
                     kwds: dict = None) -> AsyncResult:
@@ -107,11 +109,14 @@ class Pool:
         rf = self._remote(func)
         it = iter(iterable)
         inflight: deque = deque()
+        first: List[Any] = []
         try:
-            while len(inflight) < self._window():
-                inflight.append(rf.remote(next(it)))
+            while len(first) < self._window():
+                first.append((next(it),))
         except StopIteration:
             pass
+        # the initial window is the bursty part — ship it as one frame
+        inflight.extend(rf.map(first))
         while inflight:
             yield self._ray.get(inflight.popleft())
             try:
@@ -126,11 +131,14 @@ class Pool:
         pending = set()
         exhausted = False
         while True:
-            while not exhausted and len(pending) < self._window():
+            refill: List[Any] = []
+            while not exhausted and len(pending) + len(refill) < self._window():
                 try:
-                    pending.add(rf.remote(next(it)))
+                    refill.append((next(it),))
                 except StopIteration:
                     exhausted = True
+            if refill:
+                pending.update(rf.map(refill))
             if not pending:
                 return
             done, _ = self._ray.wait(list(pending), num_returns=1, timeout=60)

@@ -395,6 +395,25 @@ def test_chaos_survives_sharded_hub(plan_runtime, monkeypatch):
     assert "worker_exit" in kinds  # the expelled worker died cleanly
 
 
+def test_bulk_submit_survives_drop_and_dup(plan_runtime):
+    """The vectorized SUBMIT_TASKS frame rides the same retransmit
+    contract as unary requests: a dropped frame is resent by
+    _scan_unacked after the ack deadline, and a duplicated (or
+    replayed) frame is absorbed by the hub's per-task dedup
+    (_task_event_index) — every task runs exactly once, results land
+    in submission order."""
+    plan_runtime("seed=13;drop:submit_tasks@0.5;dup:submit_tasks@0.5;"
+                 "drop:get@0.3")
+
+    @ray_tpu.remote
+    def f(i):
+        return i * 7
+
+    for _wave in range(3):
+        refs = f.map(list(range(12)))
+        assert ray_tpu.get(refs, timeout=90) == [i * 7 for i in range(12)]
+
+
 def test_chaos_cli_renders(plan_runtime, monkeypatch, capsys):
     plan_runtime("seed=8;drop:get@0.2;worker_kill:1@100ms")
 

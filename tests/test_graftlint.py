@@ -1523,6 +1523,115 @@ def test_gl012_topology_parity_between_reactor_and_shards(tmp_path):
     assert "<topology>.e.unhandled" in symbols, symbols
 
 
+GL012_VEC_PROTOCOL = """
+SUBMIT_TASKS = "submit_tasks"
+"""
+
+GL012_VEC_HUB = """
+import protocol as P
+
+class Hub:
+    def __init__(self):
+        self._handlers = {
+            name[len("_on_"):]: getattr(self, name)
+            for name in dir(type(self))
+            if name.startswith("_on_")
+        }
+
+    def _on_submit_tasks(self, conn, p):
+        for t in p["tasks"]:
+            spec = (t["task_id"], t["args_payload"], t.get("hint"))
+            self.admit(spec)
+"""
+
+
+def test_gl012_vector_item_key_missing(tmp_path):
+    # bulk frame: the handler loops over payload["tasks"] and reads
+    # t["task_id"] / t["args_payload"] on EVERY item; a send site
+    # building the item dicts without args_payload must be flagged,
+    # and the .get-read "hint" stays optional
+    client = """
+    import protocol as P
+
+    class Client:
+        def go(self, ids):
+            payload = {
+                "tasks": [
+                    {"task_id": i, "hint": 0}
+                    for i in ids
+                ],
+            }
+            self.send(P.SUBMIT_TASKS, payload)
+    """
+    new = project_findings(
+        tmp_path,
+        {"protocol.py": GL012_VEC_PROTOCOL, "hub.py": GL012_VEC_HUB,
+         "client.py": client},
+        {"GL012"},
+    )
+    symbols = {f.symbol for f in new}
+    assert any(
+        s.endswith(".submit_tasks.tasks[].args_payload.missing")
+        for s in symbols
+    ), symbols
+    assert not any("task_id" in s for s in symbols), symbols
+    assert not any("hint" in s for s in symbols), symbols
+
+
+def test_gl012_vector_clean_when_items_conform(tmp_path):
+    client = """
+    import protocol as P
+
+    class Client:
+        def go(self, ids):
+            self.send(P.SUBMIT_TASKS, {
+                "tasks": [
+                    {"task_id": i, "args_payload": None}
+                    for i in ids
+                ],
+            })
+    """
+    new = project_findings(
+        tmp_path,
+        {"protocol.py": GL012_VEC_PROTOCOL, "hub.py": GL012_VEC_HUB,
+         "client.py": client},
+        {"GL012"},
+    )
+    assert new == [], [f.render() for f in new]
+
+
+def test_session_resolves_bulk_submit_vector_contract():
+    """The live tree's SUBMIT_TASKS contract must be visible to the
+    vector extension end to end: submit_many's item dicts on the send
+    side, _on_submit_tasks' per-item reads on the handler side, and
+    the message routed in BOTH reactor topologies."""
+    from ray_tpu.tools.graftlint.project import session_for
+
+    sess = session_for([PKG_DIR])
+    pm = sess.protocol()
+    sends = pm.sends_of("submit_tasks")
+    assert any(
+        "task_id" in s.item_keys.get("tasks", ())
+        and "args_payload" in s.item_keys["tasks"]
+        for s in sends
+    ), [(s.symbol, dict(s.item_keys)) for s in sends]
+    hs = pm.handlers_of("submit_tasks")
+    assert any(
+        {"task_id", "args_kind", "args_payload", "arg_deps", "return_ids"}
+        <= set(h.item_required.get("tasks", ()))
+        for h in hs
+    ), [(h.symbol, dict(h.item_required)) for h in hs]
+    hub_tables = [
+        t for t in pm.tables if t.kind == "prefix" and t.owner == "Hub"
+    ]
+    assert hub_tables and "submit_tasks" in hub_tables[0].msgs
+    routed = set()
+    for r in pm.routing_sets:
+        if r.sharded:
+            routed |= r.msgs
+    assert "submit_tasks" in routed
+
+
 # --------------------------------------------------------------------- GL013
 
 
