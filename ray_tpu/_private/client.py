@@ -194,6 +194,15 @@ class CoreClient:
 
         self._trace_rate = _tracing.runtime_sample_rate()
         self._trace_on = self._trace_rate > 0.0
+        # pre-bound span-record send path: the sampled hot path builds
+        # its record inline and calls these bound symbols instead of
+        # re-importing util.tracing and re-reading os.getpid() per span
+        # (the tracing_overhead bench row measures exactly this loop)
+        self._pid = os.getpid()
+        self._wall_at = _tracing.wall_at
+        from .ids import span_id_hex as _span_id_hex
+
+        self._span_id_hex = _span_id_hex
         # ambient-context probe, bound once: even with THIS process's
         # sampling off, a live trace context (a traced task executing
         # here while only the submitting driver samples — the hub and
@@ -706,13 +715,24 @@ class CoreClient:
                     span_id: str, parent_id, t0: float, t1: float,
                     **attrs) -> None:
         """Ship one finished runtime span to the hub (batched onto the
-        existing connection; never raises into the traced path)."""
-        from ..util import tracing as _t
-
-        rec = _t.make_runtime_record(
-            name, stage, trace_id, parent_id, t0, t1, span_id=span_id,
-            node_id=self.node_id, **attrs,
-        )
+        existing connection; never raises into the traced path). The
+        record is built inline against the pre-bound clock anchor — no
+        per-span import, getpid(), or intermediate attrs dict."""
+        a = {"stage": stage}
+        for k, v in attrs.items():
+            a[k] = str(v)
+        wall_at = self._wall_at
+        rec = {
+            "name": name,
+            "trace_id": trace_id,
+            "span_id": span_id,
+            "parent_id": parent_id,
+            "start": wall_at(t0),
+            "end": wall_at(t1),
+            "pid": self._pid,
+            "node_id": self.node_id,
+            "attrs": a,
+        }
         try:
             self.send_async(P.SPAN_RECORD, rec)
         except Exception:
@@ -725,9 +745,7 @@ class CoreClient:
         context to the payload, ship it, emit the client-side span, and
         remember the return ids so a later get() joins the trace.
         `t0` lets the span start before payload encoding (put path)."""
-        from ..util.tracing import new_span_id
-
-        span_id = new_span_id()
+        span_id = self._span_id_hex()
         if t0 is None:
             t0 = time.monotonic()
         payload["trace"] = (tr[0], span_id)

@@ -579,9 +579,13 @@ class Hub:
         # (the state plane itself is payload-driven: a "trace" field in
         # the message is the signal, so client-mode tracing works even
         # when the head's own env has sampling off)
-        from ..util.tracing import runtime_sample_rate
+        from ..util.tracing import make_runtime_record, runtime_sample_rate
 
         self._trace_on = runtime_sample_rate() > 0.0
+        # pre-bound record builder: _emit_runtime_span runs per traced
+        # hub stage — the per-call `from ..util.tracing import ...`
+        # lookup was measurable at sampling 1.0 (tracing_overhead row)
+        self._make_runtime_record = make_runtime_record
         self.driver_conn = None
         self._running = True
         self._dispatching = False
@@ -2595,9 +2599,7 @@ class Hub:
         in sharded mode shards funnel their measurements through the
         ring instead of calling this, GL010). Returns the span id so a
         caller can parent further spans under it."""
-        from ..util.tracing import make_runtime_record
-
-        rec = make_runtime_record(
+        rec = self._make_runtime_record(
             name, stage, trace[0],
             parent if parent is not None else trace[1],
             t0, t1, node_id="node0", **attrs,
@@ -5239,6 +5241,33 @@ class Hub:
                         "available": dict(n.avail),
                     }
                 )
+        elif kind == "serve":
+            # pivot the serve metric series into one row per
+            # (deployment, route): counters/gauges flatten to scalars,
+            # histograms keep {sum, count, buckets} so the client side
+            # (util/state.summarize_serve) can estimate percentiles and
+            # batch efficiency without a second scrape
+            self._merge_shard_metrics()
+            prefix = "ray_tpu_serve_"
+            rows: Dict[tuple, dict] = {}
+            for (mname, tags), m in self.metrics.items():
+                if not mname.startswith(prefix):
+                    continue
+                tagmap = dict(tags)
+                key = (tagmap.get("deployment", ""), tagmap.get("route", ""))
+                row = rows.setdefault(
+                    key, {"deployment": key[0], "route": key[1]}
+                )
+                short = mname[len(prefix):]
+                if m["type"] == "histogram":
+                    row[short] = {
+                        "sum": m["sum"],
+                        "count": m["count"],
+                        "buckets": [list(b) for b in m["buckets"]],
+                    }
+                else:
+                    row[short] = m["value"]
+            items = [rows[k] for k in sorted(rows)]
         self._reply(conn, p["req_id"], items=items)
 
     def _on_shutdown(self, conn, p):

@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional
 from . import protocol as P
 from .client import CoreClient
 from .serialization import dumps_inline, loads_function, loads_inline
+from ..util import tracing as _t
 
 
 class _ExecTrace:
@@ -49,8 +50,6 @@ class _ExecTrace:
     __slots__ = ("client", "trace_id", "parent", "exec_id", "t", "_tok")
 
     def __init__(self, client, trace):
-        from ..util import tracing as _t
-
         self.client = client
         self.trace_id, self.parent = trace[0], trace[1]
         self.exec_id = _t.new_span_id()  # parent for nested work
@@ -61,14 +60,10 @@ class _ExecTrace:
         self.t[key] = time.monotonic()
 
     def enter_exec(self) -> None:
-        from ..util import tracing as _t
-
         self.stamp("exec0")
         self._tok = _t.push_context((self.trace_id, self.exec_id))
 
     def exit_exec(self) -> None:
-        from ..util import tracing as _t
-
         if self._tok is not None:
             _t.pop_context(self._tok)
             self._tok = None
@@ -76,8 +71,6 @@ class _ExecTrace:
 
     def emit(self, name: str, error: Optional[str] = None,
              **extra) -> None:
-        from ..util import tracing as _t
-
         t = self.t
         recs = []
         if "args0" in t and "args1" in t:

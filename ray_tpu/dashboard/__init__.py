@@ -15,6 +15,10 @@ chrome-trace timeline, and job submission/inspection:
     GET  /api/traces             sampled distributed traces (summaries)
     GET  /api/traces/{trace_id}  one trace: raw spans + critical-path
                                  breakdown (util/tracing.analyze_trace)
+    GET  /api/serve              serve-plane SLOs: raw per-(deployment,
+                                 route) metric rows + the per-deployment
+                                 summary (latency percentiles, batch
+                                 efficiency, drain/drop counters)
     GET  /metrics                Prometheus text (user + ray_tpu_* builtin)
     GET  /api/jobs               scheduler view: {tenants (usage vs
                                  quota), jobs (fairsched registry),
@@ -118,6 +122,14 @@ class Dashboard:
             return web.Response(text=prometheus_text(),
                                 content_type="text/plain")
 
+        async def serve_state(request):
+            from ray_tpu.util.state import summarize_serve
+
+            return web.json_response({
+                "rows": self._client().list_state("serve"),
+                "summary": summarize_serve(),
+            })
+
         def _jobs_client():
             from ray_tpu.job_submission import JobSubmissionClient
 
@@ -184,6 +196,7 @@ class Dashboard:
         app.router.add_get("/api/jobs/{job_id}", job_status)
         app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
         app.router.add_get("/api/traces/{trace_id}", trace_detail)
+        app.router.add_get("/api/serve", serve_state)
         app.router.add_get("/api/{kind}", list_kind)
         app.router.add_get("/metrics", metrics)
 

@@ -575,6 +575,56 @@ def cmd_job(args) -> None:
         print(client.stop_job(args.job_id))
 
 
+def cmd_serve(args) -> None:
+    """Serve-plane SLO status: one row per (deployment, route) with
+    request/error/timeout counts, latency percentiles estimated from
+    the hub's histogram buckets, live load gauges, batch efficiency,
+    and the drain-vs-drop teardown counters."""
+    from ray_tpu.util import state as state_api
+
+    _connect(args)
+    summary = state_api.summarize_serve()
+    if args.format == "json":
+        print(json.dumps(summary, indent=2, default=str))
+        return
+
+    def _ms(v):
+        return f"{v * 1000:.1f}" if v is not None else "-"
+
+    rows = []
+    for name, dep in sorted(summary["deployments"].items()):
+        for route, r in sorted(dep["routes"].items()):
+            lat = r["latency_s"] or {}
+            rows.append({
+                "deployment": name,
+                "route": route or "-",
+                "requests": r["requests"],
+                "errors": r["errors"],
+                "timeouts": r["timeouts"],
+                "p50_ms": _ms(lat.get("p50")),
+                "p95_ms": _ms(lat.get("p95")),
+                "p99_ms": _ms(lat.get("p99")),
+                "replicas": dep["replicas"],
+                "ongoing": dep["ongoing"],
+                "queued": dep["queued"],
+                "batch_eff": (
+                    f"{dep['batch_efficiency']:.2f}"
+                    if dep["batch_efficiency"] is not None
+                    else "-"
+                ),
+                "drained": dep["drained"],
+                "dropped": dep["dropped"],
+            })
+    if not rows:
+        print("no serve metrics recorded (is a deployment running?)")
+        return
+    _print_table(rows, [
+        "deployment", "route", "requests", "errors", "timeouts",
+        "p50_ms", "p95_ms", "p99_ms", "replicas", "ongoing", "queued",
+        "batch_eff", "drained", "dropped",
+    ])
+
+
 def cmd_debug(args) -> None:
     from ray_tpu.util import rpdb
 
@@ -701,6 +751,17 @@ def _build_parser() -> argparse.ArgumentParser:
     j = jsub.add_parser("list")
     add_address(j)
     sp.set_defaults(fn=cmd_job)
+
+    sp = sub.add_parser(
+        "serve", help="serve-plane SLOs: per-deployment/per-route "
+                      "request counts, latency percentiles, batch "
+                      "efficiency"
+    )
+    ssub = sp.add_subparsers(dest="serve_cmd", required=True)
+    s = ssub.add_parser("status", help="per-deployment SLO table")
+    s.add_argument("--format", choices=["table", "json"], default="table")
+    add_address(s)
+    s.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("debug", help="attach to a remote breakpoint")
     add_address(sp)

@@ -142,6 +142,8 @@ class ServeController:
             ) if refs else ([], [])
             done_set = set(done)
             alive, alive_vers = [], []
+            victims: List[Any] = []
+            ongoing_sum = queued_sum = 0
             with self._lock:
                 model_map = self._model_ids.setdefault(name, {})
             for actor, ver, ref in zip(live, versions, refs):
@@ -153,6 +155,8 @@ class ServeController:
                         with self._lock:
                             if mux or rid in model_map:
                                 model_map[rid] = list(mux)
+                        ongoing_sum += int(stats.get("ongoing", 0))
+                        queued_sum += int(stats.get("queued", 0))
                         healthy = True
                         self._ping_misses.pop(rid, None)
                     except Exception:
@@ -164,26 +168,21 @@ class ServeController:
                 if not healthy:
                     self._ping_misses.pop(rid, None)
                     continue
-                # version bump (redeploy): retire old-code replicas
+                # version bump (redeploy): retire old-code replicas —
+                # deferred past the routing-table update so they drain
+                # in-flight requests instead of dying mid-request
                 if ver == info.version:
                     alive.append(actor)
                     alive_vers.append(ver)
                 else:
-                    try:
-                        ray_tpu.kill(actor)
-                    except Exception:
-                        pass
+                    victims.append(actor)
             while len(alive) < info.num_replicas:
                 actor = self._start_replica(info)
                 alive.append(actor)
                 alive_vers.append(info.version)
             while len(alive) > info.num_replicas:
-                victim = alive.pop()
+                victims.append(alive.pop())
                 alive_vers.pop()
-                try:
-                    ray_tpu.kill(victim)
-                except Exception:
-                    pass
             with self._lock:
                 self._replicas[name] = alive
                 self._replica_versions[name] = alive_vers
@@ -191,6 +190,13 @@ class ServeController:
                 for rid in list(model_map):
                     if rid not in alive_rids:
                         del model_map[rid]
+            # routing table now excludes the victims: drain, then kill
+            self._retire_replicas(name, victims)
+            from . import observability as obs
+
+            obs.set_deployment_gauges(
+                name, ongoing_sum, queued_sum, len(alive)
+            )
         # GC deleted deployments
         with self._lock:
             for name in list(self._replicas):
@@ -225,9 +231,52 @@ class ServeController:
         )
         return actor
 
-    def _scale_to(self, name: str, info, n: int) -> None:
+    def _retire_replicas(self, name: str, victims: List[Any]) -> None:
+        """Graceful teardown: drain in-flight requests, then kill.
+
+        Callers must have removed the victims from self._replicas FIRST
+        (so routers stop sending new work), though handles cache the
+        replica list for up to a second — the drain window absorbs that
+        too. Polls each victim's queue_len (ongoing + batch-parked, the
+        same load signal the router uses) until idle or
+        RAY_TPU_SERVE_DRAIN_TIMEOUT_S elapses; whatever is still
+        in-flight at the deadline is dropped with the kill. Both
+        outcomes are counted (drained vs dropped) so a chaos run can
+        quantify graceful degradation.
+        """
+        import os
+
         import ray_tpu
 
+        from . import observability as obs
+
+        if not victims:
+            return
+
+        def _load(actor) -> int:
+            try:
+                return int(ray_tpu.get(actor.queue_len.remote(), timeout=2.0))
+            except Exception:
+                return 0  # dead/unreachable: nothing left to drain
+
+        timeout_s = float(os.environ.get("RAY_TPU_SERVE_DRAIN_TIMEOUT_S", "5"))
+        deadline = time.monotonic() + timeout_s
+        initial = sum(_load(a) for a in victims)
+        pending = list(victims) if initial else []
+        while pending and time.monotonic() < deadline:
+            pending = [a for a in pending if _load(a) > 0]
+            if pending:
+                time.sleep(0.05)
+        dropped = sum(_load(a) for a in pending)
+        obs.count_drained(name, initial - dropped)
+        obs.count_dropped(name, dropped)
+        for actor in victims:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+
+    def _scale_to(self, name: str, info, n: int) -> None:
         with self._lock:
             live = self._replicas.get(name, [])
             keep, drop = live[:n], live[n:]
@@ -237,11 +286,7 @@ class ServeController:
             else:
                 self._replicas[name] = keep
                 self._replica_versions[name] = self._replica_versions.get(name, [])[:n]
-        for actor in drop:
-            try:
-                ray_tpu.kill(actor)
-            except Exception:
-                pass
+        self._retire_replicas(name, drop)
 
     # -- autoscaling ---------------------------------------------------
     def _autoscale(self) -> None:

@@ -53,19 +53,24 @@ class HTTPProxy:
     def ping(self) -> bool:
         return self._ready.is_set()
 
-    def _match(self, path: str) -> Optional[str]:
+    def _match(self, path: str) -> Optional[tuple]:
+        """Longest-prefix route match -> (route_prefix, deployment name)."""
         best = None
         for prefix, name in self._routes.items():
             if path == prefix or path.startswith(prefix.rstrip("/") + "/") or prefix == "/":
                 if best is None or len(prefix) > len(best[0]):
                     best = (prefix, name)
-        return best[1] if best else None
+        return best
 
     async def _handle(self, request):
         from aiohttp import web
 
         import time as _time
 
+        from ...util import tracing as _tracing
+        from . import observability as obs
+
+        t_in = _time.monotonic()
         # periodic cached refresh, off the event loop (a controller
         # stall must not freeze unrelated in-flight requests)
         if _time.monotonic() - self._routes_refreshed > 1.0:
@@ -73,15 +78,17 @@ class HTTPProxy:
             await asyncio.get_running_loop().run_in_executor(
                 None, self._refresh_routes
             )
-        name = self._match(request.path)
-        if name is None:
+        matched = self._match(request.path)
+        if matched is None:
             return web.Response(status=404, text="no deployment matches path")
+        route_prefix, name = matched
         handle = self._handles.get(name)
         if handle is None:
             from ..handle import DeploymentHandle
 
             handle = DeploymentHandle(name)
             self._handles[name] = handle
+        handle._metric_route = route_prefix
         body = await request.read()
         req = {
             "method": request.method,
@@ -90,14 +97,55 @@ class HTTPProxy:
             "body": body,
             "headers": dict(request.headers),
         }
+        # head-sample here — the ingress is the trace root for a serve
+        # request. serve.proxy_recv covers recv + parse + route match.
+        tr = obs.begin_trace()
+        proxy_sid = None
+        if tr is not None:
+            proxy_sid = obs.emit_span(
+                "serve.proxy_recv", "serve.proxy_recv", tr[0], tr[1],
+                t_in, _time.monotonic(),
+                http_method=request.method, path=request.path,
+                deployment=name,
+            )
         try:
             # routing involves blocking control-plane calls; keep the
-            # event loop free by doing route+wait on a worker thread
-            result = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: handle.remote(req).result(timeout_s=60)
-            )
+            # event loop free by doing route+wait on a worker thread.
+            # run_in_executor does NOT carry contextvars: re-push the
+            # trace context inside the worker-thread closure so the
+            # router inherits it.
+            if proxy_sid is None:
+                result = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: handle.remote(req).result(timeout_s=60)
+                )
+            else:
+
+                def _routed():
+                    token = _tracing.push_context((tr[0], proxy_sid))
+                    try:
+                        return handle.remote(req).result(timeout_s=60)
+                    finally:
+                        _tracing.pop_context(token)
+
+                result = await asyncio.get_running_loop().run_in_executor(
+                    None, _routed
+                )
         except Exception as e:
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        t_resp0 = _time.monotonic()
+        resp = self._encode(result)
+        if proxy_sid is not None:
+            obs.emit_span(
+                "serve.response_return", "serve.response_return",
+                tr[0], proxy_sid, t_resp0, _time.monotonic(),
+                status=resp.status, deployment=name,
+            )
+        return resp
+
+    def _encode(self, result):
+        """Deployment return value -> aiohttp Response."""
+        from aiohttp import web
+
         from ..response import Response as ServeResponse
 
         if isinstance(result, ServeResponse):
@@ -197,6 +245,10 @@ class GrpcIngress:
     def _call(self, method: str, request: bytes, context) -> bytes:
         import grpc
 
+        from ...util import tracing as _tracing
+        from . import observability as obs
+
+        t_in = self._time.monotonic()
         if self._time.monotonic() - self._routes_refreshed > 1.0:
             self._routes_refreshed = self._time.monotonic()
             self._refresh_routes()
@@ -205,25 +257,56 @@ class GrpcIngress:
         if route is None:
             # "/pkg.Service/Method" -> "/pkg.Service"
             route = "/" + method.strip("/").split("/")[0]
-        name = self._match(route if route.startswith("/") else f"/{route}")
-        if name is None:
+        matched = self._match(route if route.startswith("/") else f"/{route}")
+        if matched is None:
             context.abort(
                 grpc.StatusCode.NOT_FOUND,
                 f"no deployment matches route {route!r}",
             )
+        route_prefix, name = matched
         handle = self._handles.get(name)
         if handle is None:
             from ..handle import DeploymentHandle
 
             handle = DeploymentHandle(name)
             self._handles[name] = handle
+        handle._metric_route = route_prefix
         req = {"grpc_method": method, "body": request, "metadata": md}
+        tr = obs.begin_trace()
+        proxy_sid = None
+        if tr is not None:
+            proxy_sid = obs.emit_span(
+                "serve.proxy_recv", "serve.proxy_recv", tr[0], tr[1],
+                t_in, self._time.monotonic(),
+                grpc_method=method, deployment=name,
+            )
+        token = (
+            _tracing.push_context((tr[0], proxy_sid))
+            if proxy_sid is not None
+            else None
+        )
         try:
             result = handle.remote(req).result(timeout_s=60)
         except Exception as e:  # noqa: BLE001
             context.abort(
                 grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
             )
+        finally:
+            if token is not None:
+                _tracing.pop_context(token)
+        t_resp0 = self._time.monotonic()
+        try:
+            return self._encode_grpc(result, context)
+        finally:
+            if proxy_sid is not None:
+                obs.emit_span(
+                    "serve.response_return", "serve.response_return",
+                    tr[0], proxy_sid, t_resp0, self._time.monotonic(),
+                    deployment=name,
+                )
+
+    def _encode_grpc(self, result, context) -> bytes:
+        import grpc
         from ..response import Response as ServeResponse
 
         if isinstance(result, ServeResponse):

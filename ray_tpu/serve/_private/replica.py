@@ -27,6 +27,11 @@ class Replica:
         self._ongoing = 0
         self._lock = threading.Lock()
         self._total = 0
+        from . import observability as obs
+
+        # lets @serve.batch queues and multiplex wrappers (which never
+        # see the Replica) tag their metrics with this deployment
+        obs.set_current_deployment(deployment_name)
         cls = serialized_cls
         if callable(cls) and not inspect.isclass(cls):
             # function deployment: wrap into a callable object
@@ -47,11 +52,13 @@ class Replica:
         return self._ongoing
 
     def stats(self) -> Dict[str, Any]:
+        from ..batching import queued_total
         from ..multiplex import registered_model_ids
 
         return {
             "ongoing": self._ongoing,
             "total": self._total,
+            "queued": queued_total(),
             "multiplexed_model_ids": registered_model_ids(),
         }
 
@@ -72,13 +79,36 @@ class Replica:
         args: Tuple,
         kwargs: Dict,
         multiplexed_model_id: str = "",
+        request_meta: Optional[Dict[str, Any]] = None,
     ):
+        from ...util import tracing as _tracing
         from ..multiplex import _model_id_ctx
+        from . import observability as obs
 
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        # traced request: the worker's _ExecTrace pushed (trace_id,
+        # execute-span-id) as the ambient context before dispatching this
+        # actor method. serve.queue_wait back-fills the handle-enqueue ->
+        # here gap (start reconstructed from the enq_wall stamp the
+        # router sent along); serve.execute wraps the user callable.
+        ctx = _tracing.current_context()
+        exec_sid = None
+        if ctx is not None:
+            t_in = time.monotonic()
+            if request_meta and "enq_wall" in request_meta:
+                obs.emit_span(
+                    "serve.queue_wait", "serve.queue_wait", ctx[0], ctx[1],
+                    obs.mono_at_wall(request_meta["enq_wall"], t_in), t_in,
+                    deployment=self.deployment_name,
+                )
+            exec_sid = _tracing.new_span_id()
         token = _model_id_ctx.set(multiplexed_model_id)
+        trace_token = (
+            _tracing.push_context((ctx[0], exec_sid)) if exec_sid else None
+        )
+        t0 = time.monotonic()
         try:
             target = (
                 self.instance
@@ -88,18 +118,33 @@ class Replica:
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 # the coroutine executes on the replica loop THREAD —
-                # re-enter the model-id context there, the caller
-                # thread's contextvar doesn't cross
+                # re-enter the model-id (and trace) context there, the
+                # caller thread's contextvars don't cross
                 async def _with_ctx(coro=result):
                     tok = _model_id_ctx.set(multiplexed_model_id)
+                    ttok = (
+                        _tracing.push_context((ctx[0], exec_sid))
+                        if exec_sid
+                        else None
+                    )
                     try:
                         return await coro
                     finally:
+                        if ttok is not None:
+                            _tracing.pop_context(ttok)
                         _model_id_ctx.reset(tok)
 
                 result = _run_coro(_with_ctx())
             return result
         finally:
+            if trace_token is not None:
+                _tracing.pop_context(trace_token)
+            if exec_sid is not None:
+                obs.emit_span(
+                    "serve.execute", "serve.execute", ctx[0], ctx[1],
+                    t0, time.monotonic(), span_id=exec_sid,
+                    deployment=self.deployment_name, method=method_name,
+                )
             _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1

@@ -11,7 +11,22 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import time
+import weakref
 from typing import Any, Callable, List, Optional
+
+# every live batch queue in this replica process — Replica.stats() sums
+# their depths into the "queued" load signal the controller scrapes
+_QUEUES: "weakref.WeakSet[_BatchQueue]" = weakref.WeakSet()
+
+
+def queued_total() -> int:
+    """Requests parked in this process's batch queues right now."""
+    total = 0
+    for q in list(_QUEUES):
+        if q.queue is not None:
+            total += q.queue.qsize()
+    return total
 
 
 class _BatchQueue:
@@ -21,6 +36,7 @@ class _BatchQueue:
         self.timeout_s = timeout_s
         self.queue: Optional[asyncio.Queue] = None
         self.task: Optional[asyncio.Task] = None
+        _QUEUES.add(self)
 
     def _ensure(self):
         if self.queue is None:
@@ -28,15 +44,24 @@ class _BatchQueue:
             self.task = asyncio.get_running_loop().create_task(self._loop())
 
     async def submit(self, item) -> Any:
+        from ..util import tracing as _tracing
+
         self._ensure()
         fut = asyncio.get_running_loop().create_future()
-        await self.queue.put((item, fut))
+        # carry the submitter's trace context into the batch loop: the
+        # loop task was created from whichever request arrived first and
+        # its ambient context is useless for later members
+        await self.queue.put(
+            (item, fut, _tracing.current_context(), time.monotonic())
+        )
         return await fut
 
     async def _loop(self):
+        from ._private import observability as obs
+
         while True:
-            item, fut = await self.queue.get()
-            batch = [(item, fut)]
+            entry = await self.queue.get()
+            batch = [entry]
             deadline = asyncio.get_running_loop().time() + self.timeout_s
             while len(batch) < self.max_batch_size:
                 remaining = deadline - asyncio.get_running_loop().time()
@@ -50,6 +75,21 @@ class _BatchQueue:
                     break
             items = [b[0] for b in batch]
             futs = [b[1] for b in batch]
+            t_exec = time.monotonic()
+            deployment = obs.current_deployment()
+            obs.observe_batch(deployment, len(batch), self.max_batch_size)
+            for _, _, ctx, t_enq in batch:
+                # one serve.batch_wait per traced member: parked from its
+                # submit until the batch fired, nested under that
+                # request's serve.execute span
+                if ctx is not None:
+                    obs.emit_span(
+                        "serve.batch_wait", "serve.batch_wait",
+                        ctx[0], ctx[1], t_enq, t_exec,
+                        deployment=deployment,
+                        batch_size=len(batch),
+                        max_batch_size=self.max_batch_size,
+                    )
             try:
                 results = await self.fn(items)
                 if len(results) != len(items):

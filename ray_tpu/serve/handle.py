@@ -40,6 +40,26 @@ class DeploymentResponse:
         self._method = method
         self._args = args
         self._kwargs = kwargs or {}
+        # SLO accounting (serve/_private/observability.py): routed-at
+        # stamp for the latency histogram; recorded once, on the first
+        # result()/await that settles the request
+        self._t0 = time.monotonic()
+        self._recorded = False
+
+    def _record_outcome(self, error: Optional[str]) -> None:
+        if self._recorded or self._handle is None:
+            return
+        self._recorded = True
+        from ._private import observability as obs
+
+        dep = self._handle.deployment_name
+        route = getattr(self._handle, "_metric_route", "")
+        if error is None:
+            obs.observe_latency(dep, route, time.monotonic() - self._t0)
+        elif error == "timeout":
+            obs.count_timeout(dep, route)
+        else:
+            obs.count_error(dep, route)
 
     def _reroute(self) -> None:
         """Re-send this request to a live replica and adopt the new ref
@@ -57,15 +77,25 @@ class DeploymentResponse:
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import ray_tpu
-        from ray_tpu.exceptions import ActorDiedError
+        from ray_tpu.exceptions import ActorDiedError, GetTimeoutError
 
         for attempt in range(self._MAX_RETRIES + 1):
             try:
-                return ray_tpu.get(self._ref, timeout=timeout_s)
+                value = ray_tpu.get(self._ref, timeout=timeout_s)
             except ActorDiedError:
                 if self._handle is None or attempt == self._MAX_RETRIES:
+                    self._record_outcome("error")
                     raise
                 self._reroute()
+            except GetTimeoutError:
+                self._record_outcome("timeout")
+                raise
+            except BaseException:
+                self._record_outcome("error")
+                raise
+            else:
+                self._record_outcome(None)
+                return value
 
     def _to_object_ref(self):
         return self._ref
@@ -78,13 +108,20 @@ class DeploymentResponse:
         async def _get():
             for attempt in range(self._MAX_RETRIES + 1):
                 try:
-                    return await self._ref
+                    value = await self._ref
                 except ActorDiedError:
                     if self._handle is None or attempt == self._MAX_RETRIES:
+                        self._record_outcome("error")
                         raise
                     # _reroute blocks (controller RPC + replica wait):
                     # keep it off the event loop
                     await asyncio.to_thread(self._reroute)
+                except BaseException:
+                    self._record_outcome("error")
+                    raise
+                else:
+                    self._record_outcome(None)
+                    return value
 
         return _get().__await__()
 
@@ -115,6 +152,9 @@ class DeploymentHandle:
         self.method_name = method_name
         self._stream = False
         self._model_id = ""
+        # metrics "route" tag: ingress proxies stamp their matched route
+        # prefix here; direct handle calls report route=""
+        self._metric_route = ""
         self._model_map: Dict[bytes, List[str]] = {}
         self._replicas: List[Any] = []
         self._outstanding: Dict[int, int] = {}
@@ -144,6 +184,7 @@ class DeploymentHandle:
             self._model_id if multiplexed_model_id is None else multiplexed_model_id
         )
         h._model_map = self._model_map
+        h._metric_route = self._metric_route
         return h
 
     def __getattr__(self, name: str):
@@ -188,6 +229,15 @@ class DeploymentHandle:
             }
 
     def _route(self, method: str, args, kwargs) -> DeploymentResponse:
+        from ..util import tracing as _tracing
+
+        from ._private import observability as obs
+
+        # serve.route spans the whole router hop: replica wait + pick +
+        # dispatch. Inherits the proxy's trace (ambient context) or
+        # head-samples a fresh one for direct handle calls.
+        tr = obs.begin_trace()
+        t_route0 = time.monotonic()
         # unwrap composed responses: pass the underlying ref so the
         # downstream replica receives the resolved value (model
         # composition, reference handle.py DeploymentResponse chaining)
@@ -239,7 +289,30 @@ class DeploymentHandle:
             return DeploymentResponseGenerator(ref_gen)
         with self._lock:
             self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
-        ref = replica.handle_request.remote(method, args, kwargs, self._model_id)
+        obs.count_request(self.deployment_name, self._metric_route)
+        if tr is None:
+            ref = replica.handle_request.remote(
+                method, args, kwargs, self._model_id
+            )
+        else:
+            # the enqueue wall stamp rides as an ordinary pickled arg;
+            # the replica opens serve.queue_wait at this instant. The
+            # ambient push makes the task-layer submit span (and the
+            # replica's execute chain) parent under serve.route.
+            route_sid = _tracing.new_span_id()
+            meta = {"enq_wall": _tracing.wall_at(time.monotonic())}
+            token = _tracing.push_context((tr[0], route_sid))
+            try:
+                ref = replica.handle_request.remote(
+                    method, args, kwargs, self._model_id, meta
+                )
+            finally:
+                _tracing.pop_context(token)
+            obs.emit_span(
+                "serve.route", "serve.route", tr[0], tr[1],
+                t_route0, time.monotonic(), span_id=route_sid,
+                deployment=self.deployment_name, method=method,
+            )
         with self._lock:
             self._inflight[ref] = rid
         return DeploymentResponse(ref, self, method, args, kwargs)

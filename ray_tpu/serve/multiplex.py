@@ -97,9 +97,25 @@ class _ModelMultiplexWrapper:
                 return self._models[model_id]
         # Load outside the lock (loads can be slow); last-write-wins on
         # a racing duplicate load of the same id.
+        import time
+
+        from ..util import tracing as _tracing
+        from ._private import observability as obs
+
+        ctx = _tracing.current_context()
+        t0 = time.monotonic()
         model = self._load_fn(owner, model_id)
         if inspect.iscoroutine(model):
             model = _run_sync(model)
+        # an LRU miss is the multiplexing cost: surface it as a span on
+        # the traced request that paid it, and as a swap counter
+        obs.count_model_swap(obs.current_deployment())
+        if ctx is not None:
+            obs.emit_span(
+                "serve.multiplex_swap", "serve.multiplex_swap",
+                ctx[0], ctx[1], t0, time.monotonic(),
+                deployment=obs.current_deployment(), model_id=model_id,
+            )
         with self._lock:
             self._models[model_id] = model
             self._models.move_to_end(model_id)

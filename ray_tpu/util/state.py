@@ -77,6 +77,14 @@ def list_traces(filters: Optional[list] = None) -> List[dict]:
     return _apply_filters(_client().list_state("traces"), filters)
 
 
+def list_serve(filters: Optional[list] = None) -> List[dict]:
+    """Serve-plane SLO rows: one per (deployment, route) pivoted from
+    the builtin metric registry — request/error/timeout counters,
+    latency + batch histograms ({sum, count, buckets}), live load
+    gauges. summarize_serve() turns these into percentiles."""
+    return _apply_filters(_client().list_state("serve"), filters)
+
+
 def get_trace(trace_id: str) -> List[dict]:
     """All recorded spans of one trace, raw (feed these through
     ray_tpu.util.tracing.analyze_trace for the critical-path view)."""
@@ -151,6 +159,73 @@ def summarize_tasks() -> Dict[str, Any]:
         "queue_wait_s": _percentiles(queue_waits),
         "run_time_s": _percentiles(run_times),
     }
+
+
+def _hist_percentile(buckets: List[list], count: int, p: float) -> Optional[float]:
+    """Percentile estimate from histogram buckets: the upper bound of
+    the bucket where the cumulative count crosses p% of observations
+    (Prometheus histogram_quantile style, upper-bound conservative).
+    Observations above the largest boundary report that boundary."""
+    if not count or not buckets:
+        return None
+    target = p / 100.0 * count
+    cum = 0
+    for bound, c in buckets:
+        cum += c
+        if cum >= target:
+            return bound
+    return buckets[-1][0]
+
+
+def summarize_serve() -> Dict[str, Any]:
+    """Per-deployment serve SLO summary: request/error/timeout counts
+    and latency p50/p95/p99 per route (estimated from histogram
+    buckets), live load gauges (ongoing/queued/replicas), drain-vs-drop
+    teardown counters, and batch efficiency (mean actual/max batch
+    size, 1.0 = every batch full)."""
+    deployments: Dict[str, Any] = {}
+    for row in list_serve():
+        dep = deployments.setdefault(row["deployment"], {
+            "requests": 0, "errors": 0, "timeouts": 0,
+            "ongoing": 0, "queued": 0, "replicas": 0,
+            "drained": 0, "dropped": 0, "model_swaps": 0,
+            "batch_efficiency": None,
+            "routes": {},
+        })
+        rstats: Dict[str, Any] = {
+            "requests": int(row.get("requests_total", 0)),
+            "errors": int(row.get("errors_total", 0)),
+            "timeouts": int(row.get("timeouts_total", 0)),
+            "latency_s": None,
+        }
+        lat = row.get("request_latency_seconds")
+        if lat and lat["count"]:
+            rstats["latency_s"] = {
+                "p50": _hist_percentile(lat["buckets"], lat["count"], 50),
+                "p95": _hist_percentile(lat["buckets"], lat["count"], 95),
+                "p99": _hist_percentile(lat["buckets"], lat["count"], 99),
+                "mean": lat["sum"] / lat["count"],
+                "count": lat["count"],
+            }
+        dep["routes"][row["route"]] = rstats
+        dep["requests"] += rstats["requests"]
+        dep["errors"] += rstats["errors"]
+        dep["timeouts"] += rstats["timeouts"]
+        # per-deployment series (gauges, batch + teardown counters) are
+        # recorded without a route tag and so ride the route="" row
+        if "ongoing_requests" in row:
+            dep["ongoing"] = int(row["ongoing_requests"])
+        if "queue_depth" in row:
+            dep["queued"] = int(row["queue_depth"])
+        if "replicas" in row:
+            dep["replicas"] = int(row["replicas"])
+        dep["drained"] += int(row.get("drained_requests_total", 0))
+        dep["dropped"] += int(row.get("dropped_requests_total", 0))
+        dep["model_swaps"] += int(row.get("model_swaps_total", 0))
+        ratio = row.get("batch_ratio")
+        if ratio and ratio["count"]:
+            dep["batch_efficiency"] = ratio["sum"] / ratio["count"]
+    return {"deployments": deployments}
 
 
 def summarize_actors() -> Dict[str, Any]:

@@ -265,6 +265,24 @@ STAGE_PRECEDENCE: Dict[str, int] = {
     "transfer": 45,      # object plane: segment fetch (direct or relay)
     "put": 35,           # put path (client encode/stream + hub handler)
     "get": 35,           # hub GET handler
+    # ---- serve data plane (serve/_private/observability.py). The serve
+    # spans ENVELOP the task-layer spans of the underlying actor call,
+    # so precedence places them around the existing catalog instead of
+    # double-counting it: serve.queue_wait sits BELOW every task stage
+    # (it spans enqueue -> replica start, and must only be charged the
+    # genuinely-waiting slices no narrower stage covers), serve.execute
+    # sits ABOVE worker execute (the replica's request handling IS the
+    # user body there), and batch-wait/multiplex-swap sit above
+    # serve.execute so time parked inside the handler is named for what
+    # it actually was. dominant_stage then answers the serving question
+    # directly: router vs queue vs batch-wait vs execute.
+    "serve.queue_wait": 5,       # enqueue -> replica start, uncovered gap
+    "serve.proxy_recv": 22,      # ingress: recv + parse + route match
+    "serve.response_return": 24, # ingress: response encode + write
+    "serve.route": 25,           # handle: replica wait + pick + dispatch
+    "serve.execute": 70,         # replica: the user callable
+    "serve.batch_wait": 75,      # @serve.batch: parked awaiting a batch
+    "serve.multiplex_swap": 78,  # multiplex: LRU-miss model load
 }
 
 

@@ -1,0 +1,60 @@
+"""bench_serve.py harness smoke test (tier-1 safe, not marked slow).
+
+Same contract as test_bench_harness.py, for the serve-plane load
+generator: one --smoke micro-iteration end to end, and the --json
+report must cover every BASELINES row (QPS, mixed-load percentiles,
+batch efficiency, chaos success rate) — so a serve refactor that
+silently breaks the closed-loop driver or the SLO registry read fails
+CI instead of the next perf PR. Numbers are NOT checked.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench_serve.py")
+
+
+def test_smoke_run_reports_every_serve_baseline_metric(tmp_path):
+    out_path = tmp_path / "bench_serve.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+    r = subprocess.run(
+        [sys.executable, BENCH, "--smoke", "--trials", "2",
+         "--json", str(out_path)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=420,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+    data = json.loads(out_path.read_text())
+    assert data["mode"] == "smoke"
+    assert data["trials"] == 2
+    for name, rec in data["metrics"].items():
+        trials = rec.get("trials")
+        if trials is not None:
+            assert len(trials) == 2, name
+            assert (
+                min(trials) - 0.01 <= rec["value"] <= max(trials) + 0.01
+            ), (name, rec["value"], trials)
+
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from bench_serve import BASELINES
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    missing = set(BASELINES) - set(data["metrics"])
+    assert not missing, f"BASELINES metrics missing from report: {missing}"
+    for name, rec in data["metrics"].items():
+        assert rec["value"] > 0, f"{name} reported a non-positive value"
+    # efficiency and success-rate rows are ratios in (0, 1]
+    assert 0 < data["metrics"]["serve_batch_efficiency"]["value"] <= 1.0
+    assert 0 < data["metrics"]["serve_chaos_success_rate"]["value"] <= 1.0
+    # every stdout metric line is one JSON object (the scrapeable form)
+    parsed = [
+        json.loads(line) for line in r.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert {p["metric"] for p in parsed} >= set(BASELINES)
