@@ -54,9 +54,32 @@ BASELINES = {
     # plan SIGKILLed a worker mid-load (replica death -> handle reroute
     # + controller respawn); 1.0 = fully graceful degradation
     "serve_chaos_success_rate": 0.99,
+    # payload sweep (PR 14): request p50 for an echo deployment moving
+    # the SAME body both ways (request arg + response). 1 KiB and
+    # 64 KiB ride the inline hub path (64 KiB == serve_inline_max, the
+    # documented "inline still wins" boundary); 1 MiB and 8 MiB spill
+    # onto the zero-copy object plane (serve/_private/payloads.py).
+    # LOWER is better. Baselines measured at the rows' introduction
+    # (PR 14, post-payload-plane) — see BENCH_serve_pr14_after.json.
+    "serve_payload_1k_p50_ms": 1.45,
+    "serve_payload_64k_p50_ms": 1.85,
+    "serve_payload_1m_p50_ms": 5.0,
+    "serve_payload_8m_p50_ms": 16.0,
+    # multi-tenant blend (PR 14): LLM-stub + ViT-stub (spilled ndarray
+    # bodies) + CPU micro driven at once through a SHARDED hub
+    # (RAY_TPU_HUB_SHARDS=4) in a fresh subprocess cluster; total
+    # completed req/s across all three tenants
+    "serve_multitenant_qps": 480.0,
 }
 
-_LOWER_IS_BETTER = {"serve_mixed_p50_ms", "serve_mixed_p99_ms"}
+_LOWER_IS_BETTER = {
+    "serve_mixed_p50_ms",
+    "serve_mixed_p99_ms",
+    "serve_payload_1k_p50_ms",
+    "serve_payload_64k_p50_ms",
+    "serve_payload_1m_p50_ms",
+    "serve_payload_8m_p50_ms",
+}
 
 SMOKE = False
 QUICK = False
@@ -237,6 +260,42 @@ def main() -> None:
         [m[1] for m in mixed] if TRIALS else mixed[0][1], "ms",
     )
 
+    # ---- payload sweep: one echo deployment moving the same body BOTH
+    # ways per request. Above serve_inline_max (64 KiB) the body spills
+    # onto the zero-copy object plane: the handle puts it as a shm
+    # segment and the replica maps it back as a memoryview; at or below
+    # the threshold it rides VAL_INLINE through the hub — the 1 KiB and
+    # 64 KiB rows price the "inline still wins" boundary, 1 MiB / 8 MiB
+    # price the spill path the plane exists for.
+    @serve.deployment(max_ongoing_requests=16)
+    class PayloadEcho:
+        def __call__(self, x):
+            return x
+
+    echo = serve.run(PayloadEcho.bind())
+    warm = echo.remote(b"w" * 2048).result(timeout_s=60)
+    assert bytes(warm) == b"w" * 2048
+
+    sweep = [
+        ("serve_payload_1k_p50_ms", 1024, 100),
+        ("serve_payload_64k_p50_ms", 64 * 1024, 60),
+        ("serve_payload_1m_p50_ms", 1024 * 1024, 30),
+        ("serve_payload_8m_p50_ms", 8 * 1024 * 1024, 8),
+    ]
+    for metric, size, iters in sweep:
+        n = 3 if SMOKE else (max(4, iters // 2) if QUICK else iters)
+        body = b"\xa5" * size
+        # spill warm-up at this size (resolve cache, segment pool)
+        assert echo.remote(body).result(timeout_s=60) is not None
+
+        def payload_once(body=body, n=n):
+            lats, _, errs = _closed_loop(echo, 2, n, lambda k, i: body)
+            assert errs == 0, f"{errs} payload requests failed"
+            return _pctl(sorted(lats), 50) * 1e3
+
+        vals = [payload_once() for _ in range(TRIALS or 1)]
+        report(metric, vals if TRIALS else vals[0], "ms")
+
     # ---- batch efficiency from the SLO registry (cumulative over the
     # llm + mixed runs above — the same number `serve status` renders)
     from ray_tpu.util import state as state_api
@@ -254,6 +313,12 @@ def main() -> None:
 
     serve.shutdown()
     ray_tpu.shutdown()
+
+    # ---- multi-tenant blend through a SHARDED hub: fresh subprocess
+    # cluster (RAY_TPU_HUB_SHARDS is read at hub init) so the row
+    # prices the realistic topology — several tenants, reactor shards,
+    # spilled ndarray bodies on the ViT path
+    _bench_multitenant()
 
     # ---- chaos: fresh subprocess cluster (the plan is read at hub
     # init) with a worker SIGKILL firing mid-load
@@ -300,6 +365,117 @@ def _timeit(fn):
         n = fn()
         best = max(best, n / (time.perf_counter() - t0))
     return best
+
+
+def _multitenant_qps(per_thread: int) -> float:
+    """One subprocess cluster with RAY_TPU_HUB_SHARDS=4 hosting three
+    tenants at once — an LLM stub (@serve.batch over string prompts),
+    a ViT stub (@serve.batch over 224x224x3 float32 frames, ~600 KiB
+    each, so every request body rides the zero-copy payload plane) and
+    a CPU microservice — driven concurrently closed-loop. Returns total
+    completed requests / wall second across all tenants."""
+    import subprocess
+
+    script = f"""
+import sys; sys.path.insert(0, {json.dumps(os.path.dirname(os.path.abspath(__file__)))})
+import asyncio, threading, time
+import numpy as np
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=8, max_workers=6)
+
+@serve.deployment(num_replicas=2, max_ongoing_requests=16)
+class Micro:
+    def __call__(self, x):
+        return {{"ok": x * 2}}
+
+@serve.deployment(max_ongoing_requests=64)
+class LLMStub:
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.003)
+    async def generate(self, prompts):
+        await asyncio.sleep(0.004)
+        return ["gen:" + p for p in prompts]
+    async def __call__(self, prompt):
+        return await self.generate(prompt)
+
+@serve.deployment(max_ongoing_requests=32)
+class ViTStub:
+    # the batch callable IS the routed target: all spilled frames in a
+    # batch resolve through ONE shared payload fetch (payloads.py)
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.004)
+    async def __call__(self, frames):
+        await asyncio.sleep(0.003)  # one simulated forward per BATCH
+        return [float(f[0][0][0]) if hasattr(f, "shape") else float(np.asarray(f)[0,0,0]) for f in frames]
+
+micro = serve.run(Micro.bind())
+llm = serve.run(LLMStub.bind())
+vit = serve.run(ViTStub.bind())
+frame = np.full((224, 224, 3), 0.5, dtype=np.float32)  # ~600 KiB: spills
+assert micro.remote(1).result(timeout_s=60) == {{"ok": 2}}
+assert llm.remote("w").result(timeout_s=60) == "gen:w"
+assert vit.remote(frame).result(timeout_s=60) == 0.5
+
+done = [0]
+lock = threading.Lock()
+
+def drive(handle, n, payload):
+    for i in range(n):
+        handle.remote(payload(i)).result(timeout_s=60)
+        with lock:
+            done[0] += 1
+
+N = {per_thread}
+jobs = (
+    [(micro, N, lambda i: i)] * 3
+    + [(llm, N, lambda i: f"p{{i}}")] * 3
+    + [(vit, max(2, N // 4), lambda i: frame)] * 2
+)
+threads = [threading.Thread(target=drive, args=j) for j in jobs]
+t0 = time.perf_counter()
+for t in threads: t.start()
+for t in threads: t.join()
+print("QPS", done[0] / (time.perf_counter() - t0))
+serve.shutdown()
+ray_tpu.shutdown()
+"""
+    env = {**os.environ, "RAY_TPU_HUB_SHARDS": "4"}
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True,
+        text=True, timeout=300, env=env,
+    )
+    qps = next(
+        (float(line.split()[1]) for line in out.stdout.splitlines()
+         if line.startswith("QPS")),
+        None,
+    )
+    if qps is None:
+        raise RuntimeError(
+            f"multitenant subprocess rc={out.returncode}: "
+            f"{(out.stderr or out.stdout)[-400:]}"
+        )
+    return qps
+
+
+def _bench_multitenant() -> None:
+    per_thread = 5 if SMOKE else (25 if QUICK else 80)
+    samples = []
+    for _ in range(TRIALS or 1):
+        for attempt in range(3):  # same retry story as the chaos row
+            try:
+                samples.append(_multitenant_qps(per_thread))
+                break
+            except Exception as e:  # noqa: BLE001
+                if attempt == 2:
+                    raise
+                print(
+                    f"serve_multitenant trial retry after: {e}",
+                    file=sys.stderr,
+                )
+    report(
+        "serve_multitenant_qps",
+        samples if TRIALS else samples[0], "req/s",
+    )
 
 
 def _chaos_success_rate(duration_s: float, kill_at_s: float) -> float:

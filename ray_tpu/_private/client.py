@@ -494,6 +494,12 @@ class CoreClient:
                 self._known_ready.pop(oid, None)
                 self._resolve_cache.pop(oid, None)
                 self._ready_subscribed.discard(oid)
+        # drop reader mappings of the freed segments OUTSIDE the cache
+        # lock (store has its own; never nest them). Serve payloads map
+        # one segment per request — without this the mapping table grows
+        # one dead entry per request served.
+        for oid in oids:
+            self.store.drop_mapping(oid.hex())
 
     def _on_node_down(self, data) -> None:
         """Runs on the reader thread: a node died — every cached
@@ -784,11 +790,12 @@ class CoreClient:
         return None
 
     # --------------------------------------------------------------- objects
-    def put_value(self, obj: Any, object_id: Optional[ObjectID] = None) -> ObjectID:
+    def put_value(self, obj: Any, object_id: Optional[ObjectID] = None,
+                  force_shm: bool = False, cache: bool = True) -> ObjectID:
         oid = object_id or ObjectID.generate()
         tr = self._trace_begin() if self._tracing_live() else None
         if tr is None:
-            kind, payload, size = self.encode_value(oid, obj)
+            kind, payload, size = self.encode_value(oid, obj, force_shm=force_shm)
             self.send_async(
                 P.PUT,
                 {"object_id": oid.binary(), "kind": kind,
@@ -797,7 +804,7 @@ class CoreClient:
             )
         else:
             t0 = time.monotonic()  # the put span covers the encode too
-            kind, payload, size = self.encode_value(oid, obj)
+            kind, payload, size = self.encode_value(oid, obj, force_shm=force_shm)
             self._traced_send(
                 P.PUT,
                 {"object_id": oid.binary(), "kind": kind,
@@ -805,8 +812,11 @@ class CoreClient:
                 "client.put", "put", tr,
                 remember_ids=[oid.binary()], t0=t0, size=size,
             )
-        if kind == P.VAL_SHM:
-            # cache the deserialized original to avoid a re-map on local get
+        if kind == P.VAL_SHM and cache:
+            # cache the deserialized original to avoid a re-map on local
+            # get. The serve payload codec passes cache=False: the
+            # producer never re-reads its own request payload, and 4096
+            # cached MiB-scale bodies would pin gigabytes.
             with self._obj_cache_lock:
                 self._obj_cache[oid.binary()] = obj
         return oid
@@ -818,14 +828,21 @@ class CoreClient:
     CLIENT_CHUNK_THRESHOLD = 4 * 1024 * 1024
     FETCH_CHUNK = 8 * 1024 * 1024
 
-    def encode_value(self, oid: ObjectID, obj: Any) -> Tuple[str, Any, int]:
+    def encode_value(self, oid: ObjectID, obj: Any,
+                     force_shm: bool = False) -> Tuple[str, Any, int]:
         """Encode a value for transport: inline bytes or shm segment name."""
-        from .serialization import dumps_oob
+        from .serialization import RawPayload, dumps_oob
 
         header, buffers = dumps_oob(obj)
         nbytes = len(header) + sum(b.raw().nbytes for b in buffers)
-        if nbytes < INLINE_THRESHOLD or (
-            self.inline_only and nbytes < self.CLIENT_CHUNK_THRESHOLD
+        # RawPayload (and force_shm=True) is an explicit object-plane
+        # request (serve payload codec): never inline it, even below
+        # INLINE_THRESHOLD or inside the client-mode CHUNK window — the
+        # whole point is one memcpy into shm instead of a pickle ride
+        # through the hub
+        if not force_shm and not isinstance(obj, RawPayload) and (
+            nbytes < INLINE_THRESHOLD
+            or (self.inline_only and nbytes < self.CLIENT_CHUNK_THRESHOLD)
         ):
             if buffers:
                 blob = dumps_inline((header, [b.raw().tobytes() for b in buffers]))
@@ -931,6 +948,38 @@ class CoreClient:
             err = loads_inline(payload)
             raise err
         raise ValueError(f"unknown value kind {kind}")
+
+    def _decode_oneshot(self, oid_bytes: bytes, kind: str, payload: Any) -> Any:
+        """One-shot consumer decode (serve payload codec). A VAL_SHM
+        segment that is NOT already mapped locally is pulled straight
+        from the owner's object agent into memory and decoded over the
+        pulled bytes (object_store.decode_segment_bytes) — no store
+        install, no REPLICA_ADDED registration, no mapping left behind
+        for a value read exactly once. Local segments (the same-node
+        common case: driver and replicas share one objects dir) take
+        the ordinary zero-copy store.get via decode_value, which is
+        also the fallback on ANY pull irregularity — its fetch matrix
+        ends in the hub relay, so a dead agent degrades, never fails."""
+        if kind == P.VAL_SHM and not self.store.contains(payload):
+            info = self._resolve_object(oid_bytes) if self._direct_enabled else None
+            if (
+                info
+                and info.get("endpoint")
+                and not (
+                    info.get("hostname") == self.hostname
+                    and info.get("path")
+                    and os.path.isfile(info["path"])
+                )
+            ):
+                try:
+                    from .object_agent import pull_segment_bytes
+                    from .object_store import decode_segment_bytes
+
+                    blob = pull_segment_bytes(info["endpoint"], payload)
+                    return decode_segment_bytes(blob)
+                except Exception:
+                    self._invalidate_resolve(oid_bytes, info.get("endpoint"))
+        return self.decode_value(oid_bytes, kind, payload)
 
     # ------------------------------------------- out-of-band object plane
     def _resolve_object(self, oid_bytes: bytes) -> Optional[dict]:
@@ -1132,20 +1181,22 @@ class CoreClient:
             except OSError:
                 pass
 
-    def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None) -> List[Any]:
+    def get(self, object_ids: Sequence[ObjectID], timeout: Optional[float] = None,
+            oneshot: bool = False) -> List[Any]:
         if not self._tracing_live():
-            return self._get(object_ids, timeout)
+            return self._get(object_ids, timeout, oneshot=oneshot)
         ids = [o.binary() for o in object_ids]
         tr = self._trace_for_ids(ids)
         if tr is None:
-            return self._get(object_ids, timeout)
+            return self._get(object_ids, timeout, oneshot=oneshot)
         from ..util.tracing import new_span_id
 
         span_id = new_span_id()
         t0 = time.monotonic()
         err = None
         try:
-            return self._get(object_ids, timeout, trace=(tr[0], span_id))
+            return self._get(object_ids, timeout, trace=(tr[0], span_id),
+                             oneshot=oneshot)
         except BaseException as exc:
             err = type(exc).__name__
             raise
@@ -1171,7 +1222,8 @@ class CoreClient:
 
     def _get(self, object_ids: Sequence[ObjectID],
              timeout: Optional[float] = None,
-             trace: Optional[tuple] = None) -> List[Any]:
+             trace: Optional[tuple] = None,
+             oneshot: bool = False) -> List[Any]:
         out: Dict[bytes, Any] = {}
         missing = []
         with self._obj_cache_lock:
@@ -1198,6 +1250,12 @@ class CoreClient:
                 if kind == P.VAL_ERROR:
                     errs.append(loads_inline(payload))
                     out[oid_bytes] = ("__err__", errs[-1])
+                elif oneshot:
+                    # one-shot consumer semantics (serve payloads): the
+                    # value is read exactly once, so never insert it into
+                    # the cache — sustained serving would otherwise pin
+                    # thousands of dead MiB-scale bodies there
+                    out[oid_bytes] = self._decode_oneshot(oid_bytes, kind, payload)
                 else:
                     val = self.decode_value(oid_bytes, kind, payload)
                     out[oid_bytes] = val

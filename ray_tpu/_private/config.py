@@ -25,6 +25,17 @@ _DEFAULTS: Dict[str, Any] = {
     # readiness push: wait() over not-ready refs subscribes once and the
     # hub pushes ready sets; off = the classic parked-WAIT request path
     "ready_push": True,
+    # serve data plane: request/response payloads strictly larger than
+    # this spill onto the direct object plane (serve/_private/
+    # payloads.py) instead of riding VAL_INLINE through the hub;
+    # 0 disables spilling. Deliberately below inline_object_threshold:
+    # a serve payload crosses the wire twice (handle->replica,
+    # replica->consumer), so the object plane pays off earlier.
+    "serve_inline_max": 64 * 1024,
+    # HTTP ingress request-body cap (aiohttp client_max_size). The
+    # payload plane makes multi-MiB bodies routine; aiohttp's 1 MiB
+    # default would 413 them at the front door.
+    "serve_http_max_body": 1 << 30,
     # driver-side warm segment pool: pre-create + pre-fault this many
     # bytes of pooled tmpfs segments in the background at init, so the
     # FIRST large put already memcpys into faulted pages (the plasma

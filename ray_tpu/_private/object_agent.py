@@ -48,6 +48,48 @@ from .serialization import dumps_frame, loads_frame
 CHUNK = 8 * 1024 * 1024
 
 
+def pull_segment_bytes(endpoint: str, name: str) -> bytes:
+    """One-shot direct pull of a whole segment into memory.
+
+    The lightweight consumer path for serve response payloads
+    (serve/_private/payloads.py): a proxy/handle reading a one-shot
+    response body has no use for the full CoreClient fetch dance —
+    store install, REPLICA_ADDED registration, resolve caching,
+    connection pooling — so this helper opens ONE connection, streams
+    the segment, and returns the assembled bytes (decode with
+    object_store.decode_segment_bytes). Raises on ANY irregularity;
+    callers fall back to the full client fetch path, which ends in the
+    hub relay.
+    """
+    from .client import connect_hub
+
+    conn = connect_hub(endpoint)
+    try:
+        conn.send_bytes(dumps_frame((P.OBJ_GET, {"name": name})))
+        out = bytearray()
+        total = None
+        while True:
+            msg_type, p = loads_frame(conn.recv_bytes())
+            if msg_type == P.OBJ_ERROR:
+                raise OSError(p.get("error") or "agent fetch failed")
+            if msg_type != P.OBJ_DATA:
+                raise OSError(f"unexpected frame {msg_type}")
+            out += p["data"]
+            total = p.get("total", total)
+            if p.get("last"):
+                break
+        if total is not None and len(out) != total:
+            raise OSError(
+                f"short object-agent stream: {len(out)}/{total} bytes"
+            )
+        return bytes(out)
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
 class ObjectAgent:
     """Serve shm-segment reads/writes for one node's object directory.
 

@@ -67,6 +67,40 @@ def _advise_hugepage(mm: mmap.mmap) -> None:
         pass
 
 
+def _parse_segment(view: memoryview, cap: int) -> Tuple[bytes, List[memoryview]]:
+    """Parse the put_raw wire layout out of `view` (a mapped segment or
+    a pulled byte blob): returns (header, buffers) with every buffer a
+    zero-copy sub-view of `view`. `cap` bounds the self-reported total
+    so a truncated/padded source never reads past the real bytes."""
+    (total,) = struct.unpack_from("<Q", view, 0)
+    if not 16 <= total <= cap:
+        total = cap  # defensive: never read past the mapping
+    (hlen,) = struct.unpack_from("<Q", view, 8)
+    header = bytes(view[16 : 16 + hlen])
+    off = _align(16 + hlen)
+    buffers: List[memoryview] = []
+    while off < total:
+        (blen,) = struct.unpack_from("<Q", view, off)
+        off = _align(off + 8)
+        buffers.append(view[off : off + blen])
+        off = _align(off + blen)
+    return header, buffers
+
+
+def decode_segment_bytes(data) -> Any:
+    """Deserialize a whole segment pulled as one byte blob WITHOUT
+    installing it in any store — buffers stay views over `data`. This
+    is the lightweight consumer path for one-shot serve payload pulls
+    (object_agent.pull_segment_bytes): no store file, no replica
+    registration, no ref-count bookkeeping. The caller must keep the
+    returned value (its views pin `data`) alive only as long as needed."""
+    view = data if isinstance(data, memoryview) else memoryview(data)
+    if view.format != "B" or view.ndim != 1:
+        view = view.cast("B")
+    header, buffers = _parse_segment(view, view.nbytes)
+    return serialization.loads_oob(header, buffers)
+
+
 def _segment_layout(header: bytes, raws: List[memoryview]):
     """Compute (total_size, [(offset, part), ...]) for a segment.
     Parts are either bytes (metadata words) or the raw buffers."""
@@ -371,20 +405,7 @@ class ShmObjectStore:
             if seg is None:
                 seg = MappedSegment(self._path(name))
                 self._segments[name] = seg
-        mm = seg.mm
-        view = memoryview(mm)
-        (total,) = struct.unpack_from("<Q", mm, 0)
-        if not 16 <= total <= seg.size:
-            total = seg.size  # defensive: never read past the mapping
-        (hlen,) = struct.unpack_from("<Q", mm, 8)
-        header = bytes(view[16 : 16 + hlen])
-        off = _align(16 + hlen)
-        buffers: List[memoryview] = []
-        while off < total:
-            (blen,) = struct.unpack_from("<Q", mm, off)
-            off = _align(off + 8)
-            buffers.append(view[off : off + blen])
-            off = _align(off + blen)
+        header, buffers = _parse_segment(memoryview(seg.mm), seg.size)
         return serialization.loads_oob(header, buffers)
 
     def write_segment(self, name: str, data: bytes) -> None:
@@ -400,6 +421,19 @@ class ShmObjectStore:
 
     def contains(self, name: str) -> bool:
         return name in self._segments or os.path.exists(self._path(name))
+
+    def drop_mapping(self, name: str) -> None:
+        """Forget a READER mapping of a freed object. Writer segments
+        keep their free()/pool recycle path untouched; reader mappings
+        of remote or sibling-process segments have no pool value, and
+        sustained serving (one mapped payload segment per request)
+        would otherwise grow the table by one dead entry per request.
+        The mmap pages stay alive while fetched views reference them —
+        the buffer protocol keeps the exporting mmap pinned."""
+        with self._lock:
+            seg = self._segments.get(name)
+            if seg is not None and not seg.writable:
+                del self._segments[name]
 
     def free(self, name: str) -> None:
         with self._lock:

@@ -73,6 +73,56 @@ def loads_oob(header: bytes, buffers: List[Any]) -> Any:
     return pickle.loads(header[1:], buffers=buffers)
 
 
+class RawPayload:
+    """Zero-copy carrier for one large raw buffer (bytes / bytearray /
+    memoryview).
+
+    Pickling emits the buffer OUT-OF-BAND (``pickle.PickleBuffer``), so
+    a ``dumps_oob`` round produces a ~100-byte header plus the untouched
+    buffer: ``put_raw`` memcpys it into the segment once, and a reader's
+    ``loads_oob`` reconstructs a memoryview directly over the mapped
+    bytes — the body is never copied into a pickle stream on either
+    side. Plain ``bytes`` lack this property (no buffer-callback
+    support in-band), which is why the serve payload codec
+    (serve/_private/payloads.py) wraps them here before ``put_value``.
+    The unpickled form IS the memoryview, not a RawPayload — consumers
+    normalize with :func:`materialize_raw`.
+    """
+
+    __slots__ = ("view",)
+
+    def __init__(self, data):
+        view = data if isinstance(data, memoryview) else memoryview(data)
+        if view.format != "B" or view.ndim != 1:
+            view = view.cast("B")
+        self.view = view
+
+    @property
+    def nbytes(self) -> int:
+        return self.view.nbytes
+
+    def __reduce_ex__(self, protocol):
+        return (_rebuild_raw, (pickle.PickleBuffer(self.view),))
+
+
+def _rebuild_raw(buf) -> memoryview:
+    if isinstance(buf, pickle.PickleBuffer):
+        buf = buf.raw()
+    view = buf if isinstance(buf, memoryview) else memoryview(buf)
+    if view.format != "B" or view.ndim != 1:
+        view = view.cast("B")
+    return view
+
+
+def materialize_raw(value: Any) -> Any:
+    """Collapse the two shapes a fetched RawPayload can take — the
+    producer-process cache hit returns the wrapper itself, a real
+    deserialization returns the rebuilt memoryview — into a memoryview."""
+    if isinstance(value, RawPayload):
+        return value.view
+    return value
+
+
 def dumps_function(fn: Any) -> bytes:
     """Serialize a function/class by value (closures included)."""
     return cloudpickle.dumps(fn)

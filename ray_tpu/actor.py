@@ -39,6 +39,16 @@ class ActorMethod:
 
         client = worker.get_client()
         args_kind, args_payload, deps, holds = encode_args(client, args, kwargs)
+        # caller-supplied dependency pins (serve payload codec): ids of
+        # objects referenced from INSIDE the args — e.g. payload markers
+        # nested in handle_request's args tuple, which encode_args'
+        # top-level scan can't see. Riding in arg_deps gets them the
+        # same hub pin-while-in-flight protection spilled args have, so
+        # a caller dropping its refs early can't free a payload the
+        # replica hasn't fetched yet.
+        extra_deps = self._options.get("_extra_arg_deps")
+        if extra_deps:
+            deps = deps + list(extra_deps)
         num_returns = self._options.get("num_returns", 1)
         options = scheduling_options(self._options)
         if num_returns == "streaming":

@@ -39,7 +39,15 @@ class HTTPProxy:
     async def _start(self):
         from aiohttp import web
 
-        app = web.Application()
+        # aiohttp's default client_max_size (1 MiB) would 413 exactly
+        # the bodies the zero-copy payload plane exists for; cap at the
+        # configurable ingress limit instead (default 1 GiB)
+        from ..._private import config as _config
+
+        max_body = int(
+            _config.RAY_TPU_CONFIG.get("serve_http_max_body", 1 << 30)
+        )
+        app = web.Application(client_max_size=max_body)
         app.router.add_route("*", "/{tail:.*}", self._handle)
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
@@ -177,9 +185,12 @@ class HTTPProxy:
                 charset=charset,
                 headers=headers,
             )
-        if isinstance(result, (bytes, bytearray)):
+        if isinstance(result, (bytes, bytearray, memoryview)):
+            # memoryview: a zero-copy payload-plane body straight off the
+            # mapped response segment — aiohttp's BytesPayload writes
+            # bytes-like objects as-is, so no copy here either
             return web.Response(
-                body=bytes(result), content_type="application/octet-stream"
+                body=result, content_type="application/octet-stream"
             )
         if isinstance(result, str):
             return web.Response(text=result)
@@ -319,7 +330,8 @@ class GrpcIngress:
                     f"deployment returned status {result.status}",
                 )
             return result.body_bytes()
-        if isinstance(result, (bytes, bytearray)):
+        if isinstance(result, (bytes, bytearray, memoryview)):
+            # memoryview: payload-plane body; grpc wants real bytes
             return bytes(result)
         if isinstance(result, str):
             return result.encode()

@@ -84,6 +84,7 @@ class Replica:
         from ...util import tracing as _tracing
         from ..multiplex import _model_id_ctx
         from . import observability as obs
+        from . import payloads as _payloads
 
         with self._lock:
             self._ongoing += 1
@@ -115,6 +116,24 @@ class Replica:
                 if method_name == "__call__"
                 else getattr(self.instance, method_name)
             )
+            # zero-copy payload plane: bulk-resolve PayloadRef markers
+            # (and top-level ObjectRefs — composition args) in ONE get
+            # before the user callable runs; raw bodies arrive as
+            # memoryviews over the mapped segment. @serve.batch targets
+            # defer to the batch queue so the whole batch shares one
+            # fetch (batching._BatchQueue._loop).
+            if not _payloads.is_batch_target(target):
+                t_fetch0 = time.monotonic()
+                args, kwargs, n_fetched, fetched_bytes = (
+                    _payloads.resolve_args(args, kwargs)
+                )
+                if n_fetched and ctx is not None:
+                    obs.emit_span(
+                        "serve.payload_fetch", "serve.payload_fetch",
+                        ctx[0], ctx[1], t_fetch0, time.monotonic(),
+                        deployment=self.deployment_name,
+                        n=n_fetched, nbytes=fetched_bytes,
+                    )
             result = target(*args, **kwargs)
             if inspect.iscoroutine(result):
                 # the coroutine executes on the replica loop THREAD —
@@ -135,7 +154,9 @@ class Replica:
                         _model_id_ctx.reset(tok)
 
                 result = _run_coro(_with_ctx())
-            return result
+            # oversized raw results ride back as shm segments instead
+            # of pickling through the hub (payloads.wrap_result)
+            return _payloads.wrap_result(result)
         finally:
             if trace_token is not None:
                 _tracing.pop_context(trace_token)

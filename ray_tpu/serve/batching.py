@@ -58,6 +58,7 @@ class _BatchQueue:
 
     async def _loop(self):
         from ._private import observability as obs
+        from ._private import payloads as _payloads
 
         while True:
             entry = await self.queue.get()
@@ -90,6 +91,31 @@ class _BatchQueue:
                         batch_size=len(batch),
                         max_batch_size=self.max_batch_size,
                     )
+            if _payloads.has_payload_refs(items):
+                # zero-copy payload plane: ALL members' spilled bodies
+                # resolve through ONE shared bulk get — the reason
+                # replica.handle_request defers resolution for batch
+                # targets. Off the event loop: the fetch may block on a
+                # remote agent and must not park unrelated queues.
+                # (After the batch_wait spans: their window ends at
+                # t_exec, so the fetch slice stays payload_fetch's.)
+                t_fetch0 = time.monotonic()
+                items, n_fetched, fetched_bytes = (
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, _payloads.resolve_batch_items, items
+                    )
+                )
+                t_fetch1 = time.monotonic()
+                for _, _, ctx, _ in batch:
+                    # charged per traced member: the batch shares the
+                    # wall-clock window, not N copies of the bytes
+                    if ctx is not None:
+                        obs.emit_span(
+                            "serve.payload_fetch", "serve.payload_fetch",
+                            ctx[0], ctx[1], t_fetch0, t_fetch1,
+                            deployment=deployment, n=n_fetched,
+                            nbytes=fetched_bytes, shared=len(batch),
+                        )
             try:
                 results = await self.fn(items)
                 if len(results) != len(items):

@@ -40,6 +40,12 @@ class DeploymentResponse:
         self._method = method
         self._args = args
         self._kwargs = kwargs or {}
+        # owned twin refs of payloads spilled onto the object plane for
+        # this request (serve/_private/payloads.py). Living here — not
+        # on the task ref — they survive _reroute's ref swap, and
+        # ownership GC frees the segments when the caller drops the
+        # response.
+        self._payload_holds = None
         # SLO accounting (serve/_private/observability.py): routed-at
         # stamp for the latency histogram; recorded once, on the first
         # result()/await that settles the request
@@ -76,12 +82,20 @@ class DeploymentResponse:
         self._ref = fresh._ref
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
-        import ray_tpu
         from ray_tpu.exceptions import ActorDiedError, GetTimeoutError
+
+        from .._private import worker
+        from ._private import payloads as _payloads
 
         for attempt in range(self._MAX_RETRIES + 1):
             try:
-                value = ray_tpu.get(self._ref, timeout=timeout_s)
+                # one-shot consumer get: a large (shm) response maps
+                # zero-copy when local and pulls straight from the
+                # owner's object agent when remote — never installed
+                # into the value cache (payloads.py)
+                value = worker.get_client().get(
+                    [self._ref._id], timeout=timeout_s, oneshot=True
+                )[0]
             except ActorDiedError:
                 if self._handle is None or attempt == self._MAX_RETRIES:
                     self._record_outcome("error")
@@ -95,7 +109,7 @@ class DeploymentResponse:
                 raise
             else:
                 self._record_outcome(None)
-                return value
+                return _payloads.unwrap_result(value)
 
     def _to_object_ref(self):
         return self._ref
@@ -104,6 +118,8 @@ class DeploymentResponse:
         import asyncio
 
         from ray_tpu.exceptions import ActorDiedError
+
+        from ._private import payloads as _payloads
 
         async def _get():
             for attempt in range(self._MAX_RETRIES + 1):
@@ -121,7 +137,7 @@ class DeploymentResponse:
                     raise
                 else:
                     self._record_outcome(None)
-                    return value
+                    return _payloads.unwrap_result(value)
 
         return _get().__await__()
 
@@ -249,6 +265,28 @@ class DeploymentHandle:
             k: (v._to_object_ref() if isinstance(v, DeploymentResponse) else v)
             for k, v in kwargs.items()
         }
+        # zero-copy data plane: oversized raw payloads (top-level args/
+        # kwargs + one level into dict args, covering the ingress request
+        # dict's "body") spill onto the direct object plane and travel as
+        # PayloadRef markers; the replica bulk-resolves them. Streaming
+        # calls skip the codec — handle_request_streaming has no resolve
+        # pass.
+        payload_holds: List[Any] = []
+        payload_deps: List[bytes] = []
+        if not self._stream:
+            from ._private import payloads as _payloads
+
+            t_spill0 = time.monotonic()
+            args, kwargs, payload_holds, payload_deps, spilled_bytes = (
+                _payloads.spill_args(args, kwargs)
+            )
+            if payload_holds and tr is not None:
+                obs.emit_span(
+                    "serve.payload_put", "serve.payload_put", tr[0], tr[1],
+                    t_spill0, time.monotonic(),
+                    deployment=self.deployment_name,
+                    n=len(payload_holds), nbytes=spilled_bytes,
+                )
         deadline = time.monotonic() + 30.0
         while True:
             self._refresh()
@@ -290,8 +328,15 @@ class DeploymentHandle:
         with self._lock:
             self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
         obs.count_request(self.deployment_name, self._metric_route)
+        handle_request = replica.handle_request
+        if payload_deps:
+            # spilled payload ids ride the dispatch's arg_deps: the hub
+            # pins them while the call is in flight, so a caller dropping
+            # the response (and its holds) early can't free a payload the
+            # replica hasn't fetched yet
+            handle_request = handle_request.options(_extra_arg_deps=payload_deps)
         if tr is None:
-            ref = replica.handle_request.remote(
+            ref = handle_request.remote(
                 method, args, kwargs, self._model_id
             )
         else:
@@ -303,7 +348,7 @@ class DeploymentHandle:
             meta = {"enq_wall": _tracing.wall_at(time.monotonic())}
             token = _tracing.push_context((tr[0], route_sid))
             try:
-                ref = replica.handle_request.remote(
+                ref = handle_request.remote(
                     method, args, kwargs, self._model_id, meta
                 )
             finally:
@@ -315,7 +360,10 @@ class DeploymentHandle:
             )
         with self._lock:
             self._inflight[ref] = rid
-        return DeploymentResponse(ref, self, method, args, kwargs)
+        resp = DeploymentResponse(ref, self, method, args, kwargs)
+        if payload_holds:
+            resp._payload_holds = payload_holds
+        return resp
 
     def _pick(self, replicas: List[Any]):
         """Power-of-two-choices on caller-side outstanding counts."""

@@ -25,7 +25,7 @@ class Response:
         self.body = body
         self.status = int(status)
         if content_type is None:
-            if isinstance(body, (bytes, bytearray)):
+            if isinstance(body, (bytes, bytearray, memoryview)):
                 content_type = "application/octet-stream"
             elif isinstance(body, str):
                 content_type = "text/plain"
@@ -35,7 +35,10 @@ class Response:
         self.headers = dict(headers or {})
 
     def body_bytes(self) -> bytes:
-        if isinstance(self.body, (bytes, bytearray)):
+        # memoryview bodies come from the zero-copy payload plane
+        # (serve/_private/payloads.py): large bodies arrive as views
+        # over the mapped response segment
+        if isinstance(self.body, (bytes, bytearray, memoryview)):
             return bytes(self.body)
         if isinstance(self.body, str):
             return self.body.encode()

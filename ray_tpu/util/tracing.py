@@ -283,6 +283,16 @@ STAGE_PRECEDENCE: Dict[str, int] = {
     "serve.execute": 70,         # replica: the user callable
     "serve.batch_wait": 75,      # @serve.batch: parked awaiting a batch
     "serve.multiplex_swap": 78,  # multiplex: LRU-miss model load
+    # zero-copy payload plane (serve/_private/payloads.py):
+    # payload_put wraps the handle-side spill (put_value of the raw
+    # body) — above put=35 so the slice names the serve intent, below
+    # ring/transfer so genuine object-plane work keeps its name;
+    # payload_fetch wraps the replica-side bulk resolve — above
+    # serve.execute=70 (it happens inside the handler envelope and is
+    # I/O, not user code), below batch_wait so parked members still
+    # charge their park correctly.
+    "serve.payload_put": 38,     # handle: spill request body to object plane
+    "serve.payload_fetch": 72,   # replica: bulk-resolve payload refs
 }
 
 

@@ -1,0 +1,371 @@
+"""Zero-copy serve data plane (PR 14): large request/response payloads
+ride the direct object plane instead of pickling inline through the hub.
+
+Tier-1 coverage:
+  * a request body above RAY_TPU_SERVE_INLINE_MAX reaches the replica
+    as a zero-copy memoryview over the mapped segment; bodies at/below
+    the threshold stay inline bytes (the codec is size-tiered)
+  * the ingress request dict's "body" key spills (one dict level deep)
+  * ndarray payloads spill with dtype/shape preserved
+  * oversized responses round-trip: the caller receives a memoryview
+    whose bytes equal the original
+  * HTTP proxy round-trips multi-MiB bodies both ways (guards the
+    serve_http_max_body ingress cap — aiohttp's 1 MiB default 413s)
+  * ALL members of a @serve.batch batch share ONE bulk fetch
+    (payloads.FETCH_CALLS counts fetch round-trips in the replica)
+  * RAY_TPU_SERVE_INLINE_MAX=0 disables spilling end to end
+  * a traced 1 MiB request shows serve.payload_put/serve.payload_fetch
+    spans and the analyze_trace partition stays EXACT
+  * chaos: the object agent dying mid-transfer (close_after) degrades
+    both the direct put and the direct pull to the hub relay — the
+    request still succeeds and nothing is counted drained/dropped
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+BIG = 1024 * 1024        # 1 MiB — far above the 64 KiB default threshold
+SMALL = 1024             # 1 KiB — stays inline
+CHAOS_BODY = 12 * 1024 * 1024  # > one 8 MiB agent chunk, so close_after:1
+                               # kills puts AND pulls mid-stream
+
+
+@pytest.fixture
+def serve_ray():
+    ray_tpu.init(num_cpus=4, max_workers=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def traced_serve(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    ray_tpu.init(num_cpus=4, max_workers=4, ignore_reinit_error=True)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _probe_deployment():
+    @serve.deployment
+    class TypeProbe:
+        def __call__(self, x):
+            body = x["body"] if isinstance(x, dict) else x
+            if isinstance(body, (bytes, bytearray, memoryview)):
+                digest = hashlib.sha1(body).hexdigest()
+                n = len(body)
+            elif isinstance(body, np.ndarray):
+                digest = hashlib.sha1(np.ascontiguousarray(body)).hexdigest()
+                n = int(body.nbytes)
+            else:
+                digest, n = "", -1
+            return {"type": type(body).__name__, "n": n, "digest": digest}
+
+    return TypeProbe
+
+
+# ------------------------------------------------------------ request side
+def test_large_request_arrives_zero_copy_small_stays_inline(serve_ray):
+    probe = _probe_deployment()
+    handle = serve.run(probe.bind())
+    big = os.urandom(BIG)
+    out = handle.remote(big).result(timeout_s=30)
+    assert out["type"] == "memoryview", out
+    assert out["n"] == BIG
+    assert out["digest"] == hashlib.sha1(big).hexdigest()
+
+    small = os.urandom(SMALL)
+    out = handle.remote(small).result(timeout_s=30)
+    assert out["type"] == "bytes", out
+    assert out["digest"] == hashlib.sha1(small).hexdigest()
+
+
+def test_dict_body_spills_one_level_deep(serve_ray):
+    probe = _probe_deployment()
+    handle = serve.run(probe.bind())
+    big = os.urandom(BIG)
+    req = {"method": "POST", "path": "/x", "body": big, "headers": {}}
+    out = handle.remote(req).result(timeout_s=30)
+    assert out["type"] == "memoryview", out
+    assert out["digest"] == hashlib.sha1(big).hexdigest()
+
+
+def test_ndarray_request_spills_with_dtype_shape(serve_ray):
+    @serve.deployment
+    class ArrProbe:
+        def __call__(self, a):
+            return {
+                "type": type(a).__name__,
+                "dtype": str(a.dtype),
+                "shape": list(a.shape),
+                "sum": float(a.sum()),
+            }
+
+    handle = serve.run(ArrProbe.bind())
+    arr = np.arange(512 * 600, dtype=np.float32).reshape(512, 600)  # ~1.2 MB
+    out = handle.remote(arr).result(timeout_s=30)
+    assert out["type"] == "ndarray", out
+    assert out["dtype"] == "float32"
+    assert out["shape"] == [512, 600]
+    assert out["sum"] == float(arr.sum())
+
+
+# ----------------------------------------------------------- response side
+def test_large_response_roundtrip_as_memoryview(serve_ray):
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind())
+    big = os.urandom(BIG)
+    out = handle.remote(big).result(timeout_s=30)
+    # zero-copy contract: large results arrive as views over the
+    # mapped response segment, equal byte-for-byte
+    assert isinstance(out, memoryview), type(out)
+    assert bytes(out) == big
+
+    small = os.urandom(SMALL)
+    out = handle.remote(small).result(timeout_s=30)
+    assert isinstance(out, bytes), type(out)
+    assert out == small
+
+
+def test_serve_response_large_body(serve_ray):
+    @serve.deployment
+    class Resp:
+        def __call__(self, x):
+            return serve.Response(
+                bytes(x), content_type="application/x-custom"
+            )
+
+    handle = serve.run(Resp.bind())
+    big = os.urandom(BIG)
+    out = handle.remote(big).result(timeout_s=30)
+    assert isinstance(out, serve.Response)
+    assert out.content_type == "application/x-custom"
+    assert out.body_bytes() == big
+
+
+def test_http_proxy_multi_mib_roundtrip(serve_ray):
+    @serve.deployment
+    class HttpEcho:
+        def __call__(self, req):
+            return req["body"]
+
+    serve.run(HttpEcho.bind(), route_prefix="/payload",
+              http_options={"port": 18852})
+
+    import urllib.request
+
+    big = os.urandom(2 * 1024 * 1024)  # over aiohttp's 1 MiB default cap
+    deadline = time.time() + 15
+    data = None
+    while time.time() < deadline:
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:18852/payload", data=big, method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert r.status == 200
+                data = r.read()
+            break
+        except AssertionError:
+            raise
+        except Exception:
+            time.sleep(0.3)  # route table refreshes ~1s after serve.run
+    assert data == big
+
+
+# ------------------------------------------------------------ batch sharing
+def test_batch_members_share_one_fetch(serve_ray):
+    # the batch-decorated callable must BE the routed target for the
+    # deferred shared fetch (a plain __call__ forwarding into a batch
+    # method resolves per-request in handle_request instead — correct,
+    # just not shared)
+    @serve.deployment
+    class BatchProbe:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=2.0)
+        async def __call__(self, items):
+            from ray_tpu.serve._private import payloads
+
+            return [
+                {"batch": len(items), "fetches": payloads.FETCH_CALLS,
+                 "n": len(it["body"])}
+                for it in items
+            ]
+
+        def fetches(self):
+            from ray_tpu.serve._private import payloads
+
+            return payloads.FETCH_CALLS
+
+    handle = serve.run(BatchProbe.bind())
+    before = handle.fetches.remote().result(timeout_s=30)
+
+    results = [None] * 8
+    body = os.urandom(BIG)
+
+    def one(i):
+        results[i] = handle.remote({"body": body}).result(timeout_s=60)
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert all(r is not None and r["n"] == BIG for r in results), results
+    after = handle.fetches.remote().result(timeout_s=30)
+    # one bulk fetch per BATCH, not per member: distinct fetch-counter
+    # values identify distinct batches (the counter bumps once per batch)
+    batches = {(r["batch"], r["fetches"]) for r in results}
+    assert sum(b for b, _ in batches) == 8, batches
+    assert after - before == len(batches), (before, after, batches)
+    assert len(batches) < 8, f"no batch coalesced: {batches}"
+
+
+# ------------------------------------------------------- threshold control
+def test_inline_max_zero_disables_spilling(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SERVE_INLINE_MAX", "0")
+    ray_tpu.init(num_cpus=4, max_workers=4, ignore_reinit_error=True)
+    try:
+        probe = _probe_deployment()
+        handle = serve.run(probe.bind())
+        big = os.urandom(BIG)
+        out = handle.remote(big).result(timeout_s=30)
+        # no spill: the body rides the classic inline path and arrives
+        # as the pickled bytes object
+        assert out["type"] == "bytes", out
+        assert out["digest"] == hashlib.sha1(big).hexdigest()
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------------ tracing
+def test_payload_spans_and_exact_partition(traced_serve):
+    from ray_tpu._private import worker
+    from ray_tpu.util.tracing import analyze_trace
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind())
+    big = os.urandom(BIG)
+    out = handle.remote(big).result(timeout_s=30)
+    assert bytes(out) == big
+
+    want = {"serve.route", "serve.payload_put", "serve.payload_fetch"}
+    client = worker.get_client()
+    deadline = time.monotonic() + 20
+    spans = []
+    while time.monotonic() < deadline:
+        for row in client.list_state("traces"):
+            cand = client.list_state("traces", trace_id=row["trace_id"])
+            if want <= {s["name"] for s in cand}:
+                spans = cand
+                break
+        if spans:
+            break
+        time.sleep(0.1)
+    assert spans, "no trace carried the payload span chain"
+
+    put = [s for s in spans if s["name"] == "serve.payload_put"]
+    fetch = [s for s in spans if s["name"] == "serve.payload_fetch"]
+    assert len(put) == 1 and len(fetch) == 1, [s["name"] for s in spans]
+    assert int(put[0]["attrs"]["nbytes"]) >= BIG
+    assert int(fetch[0]["attrs"]["nbytes"]) >= BIG
+
+    a = analyze_trace(spans)
+    stage_sum = sum(v["dur_s"] for v in a["stages"].values())
+    assert abs(stage_sum + a["untracked_s"] - a["end_to_end_s"]) < 1e-6
+    assert "serve.payload_put" in a["stages"]
+    assert "serve.payload_fetch" in a["stages"]
+    # the point of the PR: with the body on the object plane, the
+    # dominant stage is routing/execution machinery, not a pickle ride
+    assert a["dominant_stage"] not in (
+        "client.serialize_args", "worker.deserialize_args",
+        "worker.serialize_result",
+    )
+
+
+# -------------------------------------------------------------------- chaos
+_CHAOS_DRIVER = """
+import hashlib, os, sys
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(address={addr!r})
+from ray_tpu._private import worker
+
+# defeat the same-host file-copy shortcut: force the SOCKET transfer
+# paths (direct put / direct pull) that the chaos plan targets
+worker._client.hostname = "elsewhere-host"
+
+handle = serve.get_deployment_handle("ChaosEcho")
+body = os.urandom({nbytes})
+out = handle.remote(body).result(timeout_s=120)
+assert len(out) == len(body), (len(out), len(body))
+assert hashlib.sha1(bytes(out)).hexdigest() == hashlib.sha1(body).hexdigest()
+print("CHAOS_OK", type(out).__name__)
+"""
+
+
+def test_chaos_agent_death_mid_transfer_falls_back_to_relay(monkeypatch):
+    """Agent connections die after ONE 8 MiB chunk (close_after:1): a
+    12 MiB request's direct put AND the 12 MiB response's direct pull
+    both fail mid-stream and degrade to the hub relay. The request
+    still succeeds and the serve plane counts nothing drained or
+    dropped."""
+    monkeypatch.setenv("RAY_TPU_CHAOS_OBJECT_AGENT", "close_after:1")
+    ctx = ray_tpu.init(num_cpus=2, max_workers=2, _tcp_hub=True)
+    try:
+        @serve.deployment
+        class ChaosEcho:
+            def __call__(self, x):
+                return bytes(x)
+
+        serve.run(ChaosEcho.bind())
+        addr = ctx.address_info["address"]
+
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             _CHAOS_DRIVER.format(addr=addr, nbytes=CHAOS_BODY)],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        assert "CHAOS_OK" in proc.stdout, proc.stdout
+
+        hub = ray_tpu._private.worker._hub
+        events = [
+            e for e in hub.events if e["kind"] == "object_transfer_fallback"
+        ]
+        ops = {e["op"] for e in events}
+        assert "put" in ops, events    # request spill degraded to relay
+        assert "fetch" in ops, events  # response pull degraded to relay
+
+        from ray_tpu.util.state import summarize_serve
+
+        summary = summarize_serve()
+        for dep in summary["deployments"].values():
+            assert dep.get("drained", 0) == 0, summary
+            assert dep.get("dropped", 0) == 0, summary
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
