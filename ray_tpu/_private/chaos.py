@@ -24,6 +24,16 @@ Plan grammar (``RAY_TPU_CHAOS_PLAN``, ``;``-separated directives)::
     close_after:<n>                         object agents close every conn
                                             after serving n data chunks
                                             (mid-stream transfer death)
+    replica_kill:<dep>[@<t>]                kill one serve replica of the
+                                            deployment at t (victim drawn
+                                            from the serve rng)
+    slow_replica:<dep>@<lo>-<hi>[@p]        inject U(lo, hi) execute
+                                            latency into the deployment's
+                                            replicas (per request, prob p)
+    route_partition:<dep>@<t1>-<t2>         blackhole router replica-list
+                                            refresh for the deployment in
+                                            [t1,t2) — handles run on their
+                                            stale cached set
 
 Durations accept ``10ms``, ``1.5s``, bare seconds, and the ``t+2s``
 spelling (the ``t+`` prefix is cosmetic — all times are offsets from
@@ -40,7 +50,10 @@ or Ray-Client process intercepts its own outbound sends), ``worker``
 stalls the task body before it runs), and ``agent`` (a node agent's
 outbound sends — ``drop:agent.node_heartbeat@1`` is heartbeat
 suppression without a full partition). Timed faults (conn_kill,
-worker_kill, worker_hang, partition) execute only in the hub.
+worker_kill, worker_hang, partition) execute only in the hub. The
+``serve`` scope owns the serve-plane verbs: ``replica_kill`` executes
+in the serve controller's reconcile loop, ``slow_replica`` in replica
+processes, and ``route_partition`` in every routing handle.
 
 Legacy aliases keep working: ``RAY_TPU_CHAOS_DROP="get:0.4,..."``
 translates to hub ``drop:`` rules and
@@ -64,10 +77,12 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-SCOPES = ("hub", "client", "worker", "agent", "object_agent")
+SCOPES = ("hub", "client", "worker", "agent", "object_agent", "serve")
 
 # timed-fault kinds (hub-executed), in the grammar's spelling
 TIMED_KINDS = ("conn_kill", "worker_kill", "worker_hang")
+# timed-fault kinds the serve controller executes (serve scope)
+SERVE_TIMED_KINDS = ("replica_kill",)
 
 
 class PlanError(ValueError):
@@ -104,6 +119,10 @@ class Plan:
     rules: List[Rule] = field(default_factory=list)
     timed: List[TimedFault] = field(default_factory=list)
     partitions: Dict[str, List[Tuple[float, float]]] = field(
+        default_factory=dict
+    )
+    # deployment -> blackhole windows for router replica-list refresh
+    route_partitions: Dict[str, List[Tuple[float, float]]] = field(
         default_factory=dict
     )
     close_after: int = 0
@@ -208,6 +227,37 @@ def parse_plan(text: str) -> Plan:
             if not sep2:
                 raise PlanError(f"partition needs @<t1>-<t2>: {d!r}")
             plan.partitions.setdefault(node.strip(), []).append(_window(win))
+        elif verb == "replica_kill":
+            dep, _sep2, at = rest.partition("@")
+            dep = dep.strip()
+            if not dep:
+                raise PlanError(f"replica_kill needs a deployment: {d!r}")
+            plan.timed.append(TimedFault(
+                "replica_kill", _duration(at) if at else 1.0, arg=dep,
+            ))
+        elif verb == "slow_replica":
+            parts = rest.split("@")
+            dep = parts[0].strip()
+            if len(parts) < 2 or not dep:
+                raise PlanError(
+                    f"slow_replica needs <dep>@<lo>-<hi>: {d!r}"
+                )
+            lo, hi = _window(parts[1])
+            try:
+                p = float(parts[2]) if len(parts) > 2 else 1.0
+            except ValueError:
+                raise PlanError(f"bad probability in {d!r}") from None
+            plan.rules.append(
+                Rule("slow_replica", "serve", dep, prob=p, lo=lo, hi=hi)
+            )
+        elif verb == "route_partition":
+            dep, sep2, win = rest.partition("@")
+            dep = dep.strip()
+            if not sep2 or not dep:
+                raise PlanError(
+                    f"route_partition needs <dep>@<t1>-<t2>: {d!r}"
+                )
+            plan.route_partitions.setdefault(dep, []).append(_window(win))
         elif verb == "close_after":
             try:
                 plan.close_after = max(1, int(rest))
@@ -263,14 +313,31 @@ class ChaosEngine:
         self.scope = scope
         # scope-filtered rule index: msg_type -> rules, checked per
         # message. Scopes other than this process's contribute nothing.
+        # slow_replica rules live in their own index (keyed by
+        # deployment, consulted by execute_delay — not a message fault).
         self.rules: Dict[str, List[Rule]] = {}
+        self.slow_rules: Dict[str, List[Rule]] = {}
         for r in self.plan.rules:
-            if r.scope == scope:
+            if r.scope != scope:
+                continue
+            if r.kind == "slow_replica":
+                self.slow_rules.setdefault(r.msg_type, []).append(r)
+            else:
                 self.rules.setdefault(r.msg_type, []).append(r)
-        self.timed: List[TimedFault] = (
-            list(self.plan.timed) if scope == "hub" else []
-        )
+        if scope == "hub":
+            self.timed = [
+                f for f in self.plan.timed if f.kind in TIMED_KINDS
+            ]
+        elif scope == "serve":
+            self.timed = [
+                f for f in self.plan.timed if f.kind in SERVE_TIMED_KINDS
+            ]
+        else:
+            self.timed = []
         self.partitions = self.plan.partitions if scope == "hub" else {}
+        self.route_partitions = (
+            self.plan.route_partitions if scope == "serve" else {}
+        )
         self.close_after = (
             self.plan.close_after if scope == "object_agent" else 0
         )
@@ -287,7 +354,8 @@ class ChaosEngine:
         """Does this scope have anything to inject? Inactive engines
         are replaced by None so the hot path pays one attribute load."""
         return bool(
-            self.rules or self.timed or self.partitions or self.close_after
+            self.rules or self.slow_rules or self.timed
+            or self.partitions or self.route_partitions or self.close_after
         )
 
     # ------------------------------------------------------------ lifecycle
@@ -367,6 +435,35 @@ class ChaosEngine:
         t = self.elapsed(now)
         return any(lo <= t < hi for lo, hi in wins)
 
+    # ---------------------------------------------------------- serve scope
+    def execute_delay(self, deployment: str) -> float:
+        """slow_replica draw for one request on this deployment's
+        replica: injected execute latency in seconds (0.0 = none).
+        Draws ride the scope rng in arrival order, so a fixed request
+        sequence yields a fixed delay sequence."""
+        rules = self.slow_rules.get(deployment)
+        if not rules:
+            return 0.0
+        for r in rules:
+            if r.prob < 1.0 and self.rng.random() >= r.prob:
+                continue
+            d = r.lo if r.hi <= r.lo else self.rng.uniform(r.lo, r.hi)
+            self.record("slow_replica", deployment=deployment,
+                        delay_s=round(d, 6))
+            return d
+        return 0.0
+
+    def route_partition_active(self, deployment: str,
+                               now: Optional[float] = None) -> bool:
+        """Is the router-refresh blackhole window open for this
+        deployment? Handles keep serving their stale cached replica
+        set for the duration."""
+        wins = self.route_partitions.get(deployment)
+        if not wins:
+            return False
+        t = self.elapsed(now)
+        return any(lo <= t < hi for lo, hi in wins)
+
     # ------------------------------------------------------------ reporting
     def record(self, kind: str, **fields) -> None:
         self.counts[kind] = self.counts.get(kind, 0) + 1
@@ -390,6 +487,10 @@ class ChaosEngine:
             "partitions": {
                 n: [list(w) for w in wins]
                 for n, wins in self.partitions.items()
+            },
+            "route_partitions": {
+                n: [list(w) for w in wins]
+                for n, wins in self.route_partitions.items()
             },
             "close_after": self.close_after,
             "events": list(self.events),

@@ -36,6 +36,29 @@ _DEFAULTS: Dict[str, Any] = {
     # payload plane makes multi-MiB bodies routine; aiohttp's 1 MiB
     # default would 413 them at the front door.
     "serve_http_max_body": 1 << 30,
+    # serve resilience: end-to-end request deadline (seconds). Born at
+    # the router, rides request_meta to the replica and batch queue,
+    # and bounds every blocking wait on the way (the proxy's result()
+    # and the router's no-replica wait derive from it — no more literal
+    # 60 s / 30 s). 0 = no deadline. Per-request override:
+    # handle.options(request_timeout_s=...).
+    "serve_request_timeout_s": 60.0,
+    # admission control: cap on a handle's outstanding (routed, not yet
+    # settled) requests per deployment; past it, new requests shed
+    # immediately with a retriable error (HTTP 503) instead of queueing
+    # into a timeout. 0 = unlimited. Per-deployment override:
+    # @serve.deployment(max_queued_requests=N).
+    "serve_max_queued_requests": 0,
+    # router-side replica health ejection: a replica failing this many
+    # consecutive requests is removed from the candidate set and
+    # re-probed with jittered exponential backoff until healthy again
+    "serve_ejection_failures": 3,
+    "serve_probe_base_s": 0.25,     # ejected-replica re-probe backoff base
+    "serve_probe_max_s": 5.0,       # ...and ceiling
+    # transparent replica-retry budget (replica died mid-request):
+    # bounded attempts with growing jittered delay, deadline-capped
+    "serve_retry_attempts": 3,
+    "serve_retry_base_s": 0.05,
     # driver-side warm segment pool: pre-create + pre-fault this many
     # bytes of pooled tmpfs segments in the background at init, so the
     # FIRST large put already memcpys into faulted pages (the plasma

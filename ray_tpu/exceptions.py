@@ -82,6 +82,42 @@ class TaskTimeoutError(RayError):
     retries the task first; this error is the give-up."""
 
 
+class RequestShedError(RayError):
+    """Serve admission control shed this request: the deployment's
+    ``max_queued_requests`` cap was reached, so the router refused it
+    immediately instead of queueing it into a timeout. Retriable — the
+    HTTP ingress maps it to 503 with a Retry-After hint."""
+
+    def __init__(self, deployment: str = "", queued: int = 0, cap: int = 0):
+        self.deployment = deployment
+        self.queued = queued
+        self.cap = cap
+        super().__init__(
+            f"request to deployment {deployment!r} shed: "
+            f"{queued} outstanding >= max_queued_requests={cap}"
+        )
+
+    def __reduce__(self):
+        return (RequestShedError, (self.deployment, self.queued, self.cap))
+
+
+class RequestExpiredError(RayError, TimeoutError):
+    """The request's deadline passed before the user callable ran (in
+    the router's replica wait, the replica's pre-execute check, or the
+    batch queue). Dropped without burning replica time; the HTTP
+    ingress maps it to 504."""
+
+    def __init__(self, deployment: str = "", msg: str = ""):
+        self.deployment = deployment
+        self.msg = msg or (
+            f"request to deployment {deployment!r} expired before execute"
+        )
+        super().__init__(self.msg)
+
+    def __reduce__(self):
+        return (RequestExpiredError, (self.deployment, self.msg))
+
+
 # Reference-compatible aliases
 RayTaskError = TaskError
 RayActorError = ActorError

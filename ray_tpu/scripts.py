@@ -579,7 +579,8 @@ def cmd_serve(args) -> None:
     """Serve-plane SLO status: one row per (deployment, route) with
     request/error/timeout counts, latency percentiles estimated from
     the hub's histogram buckets, live load gauges, batch efficiency,
-    and the drain-vs-drop teardown counters."""
+    the drain-vs-drop teardown counters, and the overload/resilience
+    counters (shed admissions, expired deadlines, replica ejections)."""
     from ray_tpu.util import state as state_api
 
     _connect(args)
@@ -614,6 +615,9 @@ def cmd_serve(args) -> None:
                 ),
                 "drained": dep["drained"],
                 "dropped": dep["dropped"],
+                "shed": dep.get("shed", 0),
+                "expired": dep.get("expired", 0),
+                "ejections": dep.get("ejections", 0),
             })
     if not rows:
         print("no serve metrics recorded (is a deployment running?)")
@@ -621,7 +625,7 @@ def cmd_serve(args) -> None:
     _print_table(rows, [
         "deployment", "route", "requests", "errors", "timeouts",
         "p50_ms", "p95_ms", "p99_ms", "replicas", "ongoing", "queued",
-        "batch_eff", "drained", "dropped",
+        "batch_eff", "drained", "dropped", "shed", "expired", "ejections",
     ])
 
 

@@ -57,6 +57,7 @@ class Deployment:
         *,
         num_replicas: Union[int, str, None] = None,
         max_ongoing_requests: int = 16,
+        max_queued_requests: int = 0,
         ray_actor_options: Optional[Dict[str, Any]] = None,
         user_config: Any = None,
         autoscaling_config: Optional[Dict[str, Any]] = None,
@@ -65,6 +66,7 @@ class Deployment:
         self.name = name
         self.num_replicas = num_replicas
         self.max_ongoing_requests = max_ongoing_requests
+        self.max_queued_requests = max_queued_requests
         self.ray_actor_options = ray_actor_options or {}
         self.user_config = user_config
         self.autoscaling_config = autoscaling_config
@@ -73,6 +75,7 @@ class Deployment:
         merged = {
             "num_replicas": self.num_replicas,
             "max_ongoing_requests": self.max_ongoing_requests,
+            "max_queued_requests": self.max_queued_requests,
             "ray_actor_options": self.ray_actor_options,
             "user_config": self.user_config,
             "autoscaling_config": self.autoscaling_config,
@@ -97,11 +100,16 @@ def deployment(
     name: Optional[str] = None,
     num_replicas: Union[int, str, None] = None,
     max_ongoing_requests: int = 16,
+    max_queued_requests: int = 0,
     ray_actor_options: Optional[Dict[str, Any]] = None,
     user_config: Any = None,
     autoscaling_config: Optional[Dict[str, Any]] = None,
 ):
-    """@serve.deployment decorator (reference: serve/api.py)."""
+    """@serve.deployment decorator (reference: serve/api.py).
+    ``max_queued_requests`` is the admission-control cap: outstanding
+    routed requests past it are shed with a retriable error (HTTP 503)
+    instead of queueing into a timeout; 0 defers to the
+    ``serve_max_queued_requests`` config knob (default unlimited)."""
 
     def wrap(target):
         return Deployment(
@@ -109,6 +117,7 @@ def deployment(
             name or getattr(target, "__name__", "deployment"),
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             ray_actor_options=ray_actor_options,
             user_config=user_config,
             autoscaling_config=autoscaling_config,
@@ -217,6 +226,7 @@ def _collect_deployments(app: Application, out: Dict[str, DeploymentInfo], route
         init_kwargs=kwargs,
         num_replicas=int(num),
         max_ongoing_requests=d.max_ongoing_requests,
+        max_queued_requests=d.max_queued_requests,
         ray_actor_options=d.ray_actor_options,
         user_config=d.user_config,
         autoscaling_config=d.autoscaling_config,
@@ -261,12 +271,15 @@ def run(
         except Exception:
             pass
         raise
-    # wait until every deployment has live replicas
+    # wait until every deployment has live replicas; poll cadence backs
+    # off gently so a slow first deploy doesn't hammer the controller
     deadline = time.monotonic() + 60
+    delay = 0.05
     while time.monotonic() < deadline:
         if ray_tpu.get(controller.ready.remote()):  # graftlint: disable=GL004 — readiness poll
             break
-        time.sleep(0.05)
+        time.sleep(delay)
+        delay = min(0.5, delay * 1.5)
     handle = DeploymentHandle(app.deployment.name)
     if blocking:
         try:

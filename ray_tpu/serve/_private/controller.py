@@ -14,10 +14,30 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 CONTROLLER_NAME = "__serve_controller"
 _RECONCILE_PERIOD_S = 0.25
+
+
+def drain_accounting(
+    initial: List[int], final: List[int]
+) -> Tuple[int, int]:
+    """(drained, dropped) from per-victim in-flight counts at drain
+    start vs kill time. Booked PER VICTIM — ``max(0, initial - final)``
+    drained plus ``final`` dropped — so a victim whose load *rose*
+    during the drain window (stale handles kept routing to it) books
+    its kill-time in-flight as dropped without subtracting the growth
+    from some other victim's drain count. The old aggregate-sum form
+    (``drained = sum(initial) - sum(final)``) double-counted exactly
+    that case: late arrivals inflated ``final``, deflating every
+    victim's drain credit at once — and shed requests never appear in
+    either number (they are refused at admission, before any replica
+    queue). Every admitted in-flight request lands in exactly one
+    bucket."""
+    drained = sum(max(0, i - f) for i, f in zip(initial, final))
+    dropped = sum(final)
+    return drained, dropped
 
 
 @dataclass
@@ -28,6 +48,9 @@ class DeploymentInfo:
     init_kwargs: dict
     num_replicas: int = 1
     max_ongoing_requests: int = 16
+    # admission control: cap on outstanding routed requests per handle;
+    # 0 = fall back to the serve_max_queued_requests config knob
+    max_queued_requests: int = 0
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     user_config: Any = None
     autoscaling_config: Optional[Dict[str, Any]] = None
@@ -46,6 +69,11 @@ class ServeController:
         self._model_ids: Dict[str, Dict[bytes, List[str]]] = {}
         self._lock = threading.RLock()
         self._shutdown = threading.Event()
+        # serve-scope chaos (replica_kill timed faults execute here, on
+        # the reconcile tick; None when the plan is inert)
+        from ..._private import chaos as chaos_mod
+
+        self._chaos = chaos_mod.engine_for("serve")
         self._thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile"
         )
@@ -65,6 +93,18 @@ class ServeController:
     def get_replicas(self, name: str) -> List[Any]:
         with self._lock:
             return list(self._replicas.get(name, []))
+
+    def get_routing_info(self, name: str) -> Dict[str, Any]:
+        """One RPC with everything a handle's refresh needs: the live
+        replica set plus the deployment's admission cap."""
+        with self._lock:
+            info = self._deployments.get(name)
+            return {
+                "replicas": list(self._replicas.get(name, [])),
+                "max_queued_requests": (
+                    info.max_queued_requests if info else 0
+                ),
+            }
 
     def get_multiplex_map(self, name: str) -> Dict[bytes, List[str]]:
         """replica id -> loaded model ids (router model-affinity info;
@@ -121,7 +161,46 @@ class ServeController:
 
                 traceback.print_exc()
             self._autoscale()
+            try:
+                self._run_chaos()
+            except Exception:
+                pass
             self._shutdown.wait(_RECONCILE_PERIOD_S)
+
+    def _run_chaos(self) -> None:
+        """Execute due serve-scope timed faults (replica_kill): victim
+        drawn from the serve rng over the deployment's live set, so a
+        fixed seed kills the same replica index at the same tick."""
+        eng = self._chaos
+        if eng is None or not eng.timed:
+            return
+        import ray_tpu
+
+        for fault in eng.due_faults():
+            if fault.kind != "replica_kill":
+                eng.consume(fault, fault.count - fault.fired)
+                continue
+            with self._lock:
+                live = list(self._replicas.get(fault.arg, []))
+            if not live:
+                eng.defer(fault)
+                continue
+            idx = eng.rng.randrange(len(live))
+            victim = live[idx]
+            eng.record(
+                "replica_kill", deployment=fault.arg, victim_index=idx,
+                at_s=fault.at,
+            )
+            eng.consume(fault)
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+
+    def chaos_snapshot(self) -> Dict[str, Any]:
+        """The serve chaos engine's state (fired events, pending timed
+        schedule) — the determinism probe for seeded serve soaks."""
+        return self._chaos.snapshot() if self._chaos is not None else {}
 
     def _reconcile_once(self) -> None:
         import ray_tpu
@@ -261,14 +340,18 @@ class ServeController:
 
         timeout_s = float(os.environ.get("RAY_TPU_SERVE_DRAIN_TIMEOUT_S", "5"))
         deadline = time.monotonic() + timeout_s
-        initial = sum(_load(a) for a in victims)
-        pending = list(victims) if initial else []
+        initial = [_load(a) for a in victims]
+        pending = [a for a, n in zip(victims, initial) if n > 0]
         while pending and time.monotonic() < deadline:
             pending = [a for a in pending if _load(a) > 0]
             if pending:
                 time.sleep(0.05)
-        dropped = sum(_load(a) for a in pending)
-        obs.count_drained(name, initial - dropped)
+        still_pending = {id(a) for a in pending}
+        final = [
+            _load(a) if id(a) in still_pending else 0 for a in victims
+        ]
+        drained, dropped = drain_accounting(initial, final)
+        obs.count_drained(name, drained)
         obs.count_dropped(name, dropped)
         for actor in victims:
             try:

@@ -58,6 +58,9 @@ BATCH_RATIO_HIST = "ray_tpu_serve_batch_ratio"
 MODEL_SWAPS_TOTAL = "ray_tpu_serve_model_swaps_total"
 DRAINED_TOTAL = "ray_tpu_serve_drained_requests_total"
 DROPPED_TOTAL = "ray_tpu_serve_dropped_requests_total"
+SHED_TOTAL = "ray_tpu_serve_shed_total"
+EXPIRED_TOTAL = "ray_tpu_serve_expired_requests_total"
+EJECTIONS_TOTAL = "ray_tpu_serve_ejections_total"
 
 # The deployment this replica process hosts (set by Replica.__init__):
 # lets @serve.batch queues — which only see the bound user function —
@@ -253,3 +256,25 @@ def count_dropped(deployment: str, n: int) -> None:
     if n > 0:
         _record(DROPPED_TOTAL, "counter", float(n), "add", _tags(deployment),
                 description="in-flight requests dropped at replica teardown")
+
+
+# Shed / expired / ejected are DISJOINT from drained / dropped by
+# construction: a shed request never reaches a replica (refused at
+# admission), an expired one is dropped before its user callable runs,
+# and both are also disjoint from each other — the router sheds before
+# it stamps a deadline. Drain accounting at teardown therefore only
+# ever sees admitted, unexpired in-flight work.
+def count_shed(deployment: str, route: str = "") -> None:
+    _record(SHED_TOTAL, "counter", 1.0, "add", _tags(deployment, route),
+            description="requests shed at admission (max_queued_requests)")
+
+
+def count_expired(deployment: str, route: str = "") -> None:
+    _record(EXPIRED_TOTAL, "counter", 1.0, "add", _tags(deployment, route),
+            description="requests whose deadline passed before execute")
+
+
+def count_ejection(deployment: str) -> None:
+    _record(EJECTIONS_TOTAL, "counter", 1.0, "add", _tags(deployment),
+            description="replicas ejected from the router after "
+                        "consecutive failures")

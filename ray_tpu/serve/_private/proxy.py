@@ -16,6 +16,39 @@ import threading
 from typing import Any, Dict, Optional
 
 
+def _error_status(e: BaseException) -> int:
+    """HTTP status bucket for an ingress failure. Replica-raised
+    exceptions arrive wrapped in TaskError — classify on the cause.
+    503 = shed (admission control, retriable), 504 = deadline expired
+    (router wait, pre-execute drop, or result() deadline), 500 = rest.
+    """
+    from ray_tpu.exceptions import (
+        GetTimeoutError,
+        RequestExpiredError,
+        RequestShedError,
+        TaskError,
+    )
+
+    cause = e
+    if isinstance(e, TaskError) and e.cause is not None:
+        cause = e.cause
+    if isinstance(cause, RequestShedError):
+        return 503
+    if isinstance(cause, (RequestExpiredError, GetTimeoutError)):
+        return 504
+    return 500
+
+
+def _request_timeout_override(raw: Optional[str]) -> Optional[float]:
+    """Parse a per-request deadline override (header/metadata value)."""
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
 class HTTPProxy:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self.host = host
@@ -97,6 +130,14 @@ class HTTPProxy:
             handle = DeploymentHandle(name)
             self._handles[name] = handle
         handle._metric_route = route_prefix
+        # per-request deadline override; otherwise the handle derives
+        # the deadline from serve_request_timeout_s and every blocking
+        # wait below (route + result) is capped by it — no literal 60 s
+        t_override = _request_timeout_override(
+            request.headers.get("X-Request-Timeout-S")
+        )
+        if t_override is not None:
+            handle = handle.options(request_timeout_s=t_override)
         body = await request.read()
         req = {
             "method": request.method,
@@ -124,14 +165,14 @@ class HTTPProxy:
             # router inherits it.
             if proxy_sid is None:
                 result = await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: handle.remote(req).result(timeout_s=60)
+                    None, lambda: handle.remote(req).result()
                 )
             else:
 
                 def _routed():
                     token = _tracing.push_context((tr[0], proxy_sid))
                     try:
-                        return handle.remote(req).result(timeout_s=60)
+                        return handle.remote(req).result()
                     finally:
                         _tracing.pop_context(token)
 
@@ -139,7 +180,13 @@ class HTTPProxy:
                     None, _routed
                 )
         except Exception as e:
-            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+            status = _error_status(e)
+            headers = {"Retry-After": "1"} if status == 503 else None
+            return web.Response(
+                status=status,
+                text=f"{type(e).__name__}: {e}",
+                headers=headers,
+            )
         t_resp0 = _time.monotonic()
         resp = self._encode(result)
         if proxy_sid is not None:
@@ -282,6 +329,11 @@ class GrpcIngress:
             handle = DeploymentHandle(name)
             self._handles[name] = handle
         handle._metric_route = route_prefix
+        # per-request deadline override via metadata (the gRPC twin of
+        # the X-Request-Timeout-S header)
+        t_override = _request_timeout_override(md.get("request-timeout-s"))
+        if t_override is not None:
+            handle = handle.options(request_timeout_s=t_override)
         req = {"grpc_method": method, "body": request, "metadata": md}
         tr = obs.begin_trace()
         proxy_sid = None
@@ -297,11 +349,17 @@ class GrpcIngress:
             else None
         )
         try:
-            result = handle.remote(req).result(timeout_s=60)
+            result = handle.remote(req).result()
         except Exception as e:  # noqa: BLE001
-            context.abort(
-                grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+            status = _error_status(e)
+            code = (
+                grpc.StatusCode.RESOURCE_EXHAUSTED
+                if status == 503
+                else grpc.StatusCode.DEADLINE_EXCEEDED
+                if status == 504
+                else grpc.StatusCode.INTERNAL
             )
+            context.abort(code, f"{type(e).__name__}: {e}")
         finally:
             if token is not None:
                 _tracing.pop_context(token)

@@ -13,6 +13,21 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+# serve-scope chaos engine (slow_replica execute-latency injection),
+# built once per replica process; None-cached when the plan is inert
+_chaos_engine = None
+_chaos_ready = False
+
+
+def _chaos():
+    global _chaos_engine, _chaos_ready
+    if not _chaos_ready:
+        from ..._private import chaos as chaos_mod
+
+        _chaos_engine = chaos_mod.engine_for("serve")
+        _chaos_ready = True
+    return _chaos_engine
+
 
 class Replica:
     def __init__(
@@ -89,6 +104,31 @@ class Replica:
         with self._lock:
             self._ongoing += 1
             self._total += 1
+        # deadline propagation: the router stamped deadline_wall into
+        # request_meta; convert to THIS process's monotonic clock (same
+        # host, anchored wall offset). An already-expired request is
+        # dropped HERE — before payload resolution and before the user
+        # callable burns replica time.
+        deadline_mono: Optional[float] = None
+        if request_meta and "deadline_wall" in request_meta:
+            t_now = time.monotonic()
+            deadline_mono = t_now + (
+                request_meta["deadline_wall"] - _tracing.wall_at(t_now)
+            )
+            if deadline_mono <= t_now:
+                with self._lock:
+                    self._ongoing -= 1
+                obs.count_expired(self.deployment_name)
+                from ray_tpu.exceptions import RequestExpiredError
+
+                raise RequestExpiredError(self.deployment_name)
+        # slow_replica chaos: injected execute latency, drawn from the
+        # serve-scope rng in request-arrival order
+        eng = _chaos()
+        if eng is not None:
+            d = eng.execute_delay(self.deployment_name)
+            if d > 0.0:
+                time.sleep(d)
         # traced request: the worker's _ExecTrace pushed (trace_id,
         # execute-span-id) as the ambient context before dispatching this
         # actor method. serve.queue_wait back-fills the handle-enqueue ->
@@ -105,7 +145,10 @@ class Replica:
                     deployment=self.deployment_name,
                 )
             exec_sid = _tracing.new_span_id()
+        from ..batching import _deadline_ctx
+
         token = _model_id_ctx.set(multiplexed_model_id)
+        dl_token = _deadline_ctx.set(deadline_mono)
         trace_token = (
             _tracing.push_context((ctx[0], exec_sid)) if exec_sid else None
         )
@@ -141,6 +184,9 @@ class Replica:
                 # caller thread's contextvars don't cross
                 async def _with_ctx(coro=result):
                     tok = _model_id_ctx.set(multiplexed_model_id)
+                    # the deadline rides to the loop thread too, so a
+                    # @serve.batch submit parks it alongside the member
+                    dtok = _deadline_ctx.set(deadline_mono)
                     ttok = (
                         _tracing.push_context((ctx[0], exec_sid))
                         if exec_sid
@@ -151,6 +197,7 @@ class Replica:
                     finally:
                         if ttok is not None:
                             _tracing.pop_context(ttok)
+                        _deadline_ctx.reset(dtok)
                         _model_id_ctx.reset(tok)
 
                 result = _run_coro(_with_ctx())
@@ -166,6 +213,7 @@ class Replica:
                     t0, time.monotonic(), span_id=exec_sid,
                     deployment=self.deployment_name, method=method_name,
                 )
+            _deadline_ctx.reset(dl_token)
             _model_id_ctx.reset(token)
             with self._lock:
                 self._ongoing -= 1

@@ -10,6 +10,7 @@ lever that turns scalar requests into MXU-sized batches.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import functools
 import time
 import weakref
@@ -18,6 +19,15 @@ from typing import Any, Callable, List, Optional
 # every live batch queue in this replica process — Replica.stats() sums
 # their depths into the "queued" load signal the controller scrapes
 _QUEUES: "weakref.WeakSet[_BatchQueue]" = weakref.WeakSet()
+
+# the ambient request deadline (monotonic, THIS process's clock), set by
+# Replica.handle_request before the user callable runs. A @serve.batch
+# wrapper reads it at submit so the batch loop can drop a member whose
+# deadline expires while parked — before the batch executes — without
+# poisoning the rest of the batch.
+_deadline_ctx: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "serve_request_deadline", default=None
+)
 
 
 def queued_total() -> int:
@@ -48,11 +58,13 @@ class _BatchQueue:
 
         self._ensure()
         fut = asyncio.get_running_loop().create_future()
-        # carry the submitter's trace context into the batch loop: the
-        # loop task was created from whichever request arrived first and
-        # its ambient context is useless for later members
+        # carry the submitter's trace context AND deadline into the
+        # batch loop: the loop task was created from whichever request
+        # arrived first and its ambient context is useless for later
+        # members
         await self.queue.put(
-            (item, fut, _tracing.current_context(), time.monotonic())
+            (item, fut, _tracing.current_context(), time.monotonic(),
+             _deadline_ctx.get())
         )
         return await fut
 
@@ -74,12 +86,28 @@ class _BatchQueue:
                     )
                 except asyncio.TimeoutError:
                     break
-            items = [b[0] for b in batch]
-            futs = [b[1] for b in batch]
+            # pre-execute deadline check: a member that expired while
+            # parked is dropped HERE — its future gets the expiry error
+            # and it never reaches the user function, so an abandoned
+            # request can't poison (or bloat) the batch it parked in
             t_exec = time.monotonic()
             deployment = obs.current_deployment()
+            expired = [b for b in batch
+                       if b[4] is not None and b[4] <= t_exec]
+            if expired:
+                from ray_tpu.exceptions import RequestExpiredError
+
+                for _, fut, _, _, _ in expired:
+                    if not fut.done():
+                        fut.set_exception(RequestExpiredError(deployment))
+                    obs.count_expired(deployment)
+                batch = [b for b in batch if b[4] is None or b[4] > t_exec]
+                if not batch:
+                    continue
+            items = [b[0] for b in batch]
+            futs = [b[1] for b in batch]
             obs.observe_batch(deployment, len(batch), self.max_batch_size)
-            for _, _, ctx, t_enq in batch:
+            for _, _, ctx, t_enq, _ in batch:
                 # one serve.batch_wait per traced member: parked from its
                 # submit until the batch fired, nested under that
                 # request's serve.execute span
@@ -106,7 +134,7 @@ class _BatchQueue:
                     )
                 )
                 t_fetch1 = time.monotonic()
-                for _, _, ctx, _ in batch:
+                for _, _, ctx, _, _ in batch:
                     # charged per traced member: the batch shares the
                     # wall-clock window, not N copies of the bytes
                     if ctx is not None:

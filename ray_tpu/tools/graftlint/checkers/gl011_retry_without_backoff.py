@@ -9,7 +9,9 @@ congestion (the thundering-herd failure the reference avoids with
 exponential backoff in ``rpc/retryable_grpc_client.h``).
 
 The checker flags a ``while`` loop in runtime-core code
-(``ray_tpu/_private/``) that
+(any ``_private/`` package, plus ``ray_tpu/serve/`` — the serve plane's
+ejection re-probe and transparent handle-retry loops resend on exactly
+this shape) that
 
   1. parks on a *wait-like* call (``.wait(...)``, a ``*wait`` helper
      such as ``concurrent.futures.wait``, or ``time.sleep``) whose
@@ -39,8 +41,10 @@ from ..core import FileContext, Finding, qualname_map, register, walk_local
 
 # attribute/function spellings that park the loop for a bounded time
 _WAIT_ATTRS = {"wait", "sleep"}
-# attribute spellings that (re-)transmit on the wire
-_RESEND_ATTRS = {"send", "send_async", "send_bytes", "request"}
+# attribute spellings that (re-)transmit on the wire; "remote" covers
+# the serve plane (handle retries / health re-probes dispatch through
+# actor_method.remote(...))
+_RESEND_ATTRS = {"send", "send_async", "send_bytes", "request", "remote"}
 
 
 def _is_wait_call(node: ast.Call, ctx: FileContext) -> bool:
@@ -162,7 +166,7 @@ def _has_growth(loop: ast.While, names: Set[str]) -> bool:
 @register("GL011", "retry-without-backoff")
 def check(ctx: FileContext) -> List[Finding]:
     norm = "/" + ctx.path.replace(os.sep, "/")
-    if "/_private/" not in norm:
+    if "/_private/" not in norm and "ray_tpu/serve/" not in norm:
         return []
     out: List[Finding] = []
     quals = qualname_map(ctx.tree)

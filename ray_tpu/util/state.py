@@ -189,6 +189,7 @@ def summarize_serve() -> Dict[str, Any]:
             "requests": 0, "errors": 0, "timeouts": 0,
             "ongoing": 0, "queued": 0, "replicas": 0,
             "drained": 0, "dropped": 0, "model_swaps": 0,
+            "shed": 0, "expired": 0, "ejections": 0,
             "batch_efficiency": None,
             "routes": {},
         })
@@ -222,6 +223,12 @@ def summarize_serve() -> Dict[str, Any]:
         dep["drained"] += int(row.get("drained_requests_total", 0))
         dep["dropped"] += int(row.get("dropped_requests_total", 0))
         dep["model_swaps"] += int(row.get("model_swaps_total", 0))
+        # overload/resilience counters: shed (admission refusals) and
+        # expired (deadline drops) are disjoint from drained/dropped —
+        # shed requests were never admitted, expired ones never ran
+        dep["shed"] += int(row.get("shed_total", 0))
+        dep["expired"] += int(row.get("expired_requests_total", 0))
+        dep["ejections"] += int(row.get("ejections_total", 0))
         ratio = row.get("batch_ratio")
         if ratio and ratio["count"]:
             dep["batch_efficiency"] = ratio["sum"] / ratio["count"]

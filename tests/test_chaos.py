@@ -555,3 +555,96 @@ def test_fetch_retransmit_during_reconstruction_parks(monkeypatch):
         assert not hub._reconstructing, "reconstruction flag leaked"
     finally:
         cluster.shutdown()
+
+
+# --------------------------------------------------------------- serve verbs
+
+
+def test_serve_verbs_parse():
+    from ray_tpu._private.chaos import parse_plan
+
+    p = parse_plan(
+        "seed=5;replica_kill:llm@2s;replica_kill:vit;"
+        "slow_replica:vit@10ms-50ms@0.5;route_partition:llm@1s-3s"
+    )
+    timed = [(f.kind, f.arg, f.at) for f in p.timed]
+    # replica_kill defaults to t=1s, schedule sorted by fire time
+    assert timed == [
+        ("replica_kill", "vit", 1.0), ("replica_kill", "llm", 2.0),
+    ]
+    slow = next(r for r in p.rules if r.kind == "slow_replica")
+    assert (slow.scope, slow.msg_type, slow.prob) == ("serve", "vit", 0.5)
+    assert (slow.lo, slow.hi) == (0.01, 0.05)
+    assert p.route_partitions == {"llm": [(1.0, 3.0)]}
+
+
+def test_serve_verbs_reject_malformed():
+    from ray_tpu._private.chaos import PlanError, parse_plan
+
+    for bad in ("replica_kill:", "slow_replica:llm", "slow_replica:@1ms-2ms",
+                "slow_replica:llm@5s-1s", "slow_replica:llm@1ms-2ms@oops",
+                "route_partition:llm", "route_partition:@1s-2s",
+                "route_partition:llm@3s-1s"):
+        with pytest.raises(PlanError):
+            parse_plan(bad)
+
+
+def test_serve_verbs_are_scope_filtered():
+    """Serve-plane faults live only in serve-scope engines: the hub
+    scope must not see replica_kill in its timed schedule, and the
+    serve scope must not inherit hub timed faults or node partitions."""
+    from ray_tpu._private.chaos import ChaosEngine
+
+    plan = ("seed=9;replica_kill:llm@2s;slow_replica:llm@1ms-2ms;"
+            "route_partition:llm@1s-3s;worker_kill:1@1s;"
+            "partition:node2@3s-5s;drop:get@0.5")
+    serve = ChaosEngine(plan, "serve")
+    assert [(f.kind, f.arg) for f in serve.timed] == [("replica_kill", "llm")]
+    assert set(serve.slow_rules) == {"llm"}
+    assert set(serve.route_partitions) == {"llm"}
+    assert not serve.rules and not serve.partitions
+    hub = ChaosEngine(plan, "hub")
+    assert [f.kind for f in hub.timed] == ["worker_kill"]
+    assert not hub.slow_rules and not hub.route_partitions
+    assert set(hub.rules) == {"get"}
+    assert set(hub.partitions) == {"node2"}
+
+
+def test_serve_scope_inert_without_serve_verbs(monkeypatch):
+    from ray_tpu._private import chaos
+
+    monkeypatch.setenv("RAY_TPU_CHAOS_PLAN", "seed=1;drop:get@0.5")
+    monkeypatch.delenv("RAY_TPU_CHAOS_DROP", raising=False)
+    monkeypatch.delenv("RAY_TPU_CHAOS_OBJECT_AGENT", raising=False)
+    assert chaos.engine_for("serve") is None
+    monkeypatch.setenv(
+        "RAY_TPU_CHAOS_PLAN", "seed=1;slow_replica:llm@1ms-2ms"
+    )
+    eng = chaos.engine_for("serve")
+    assert eng is not None and set(eng.slow_rules) == {"llm"}
+
+
+def test_serve_draws_are_deterministic():
+    """Same (seed, scope) -> identical slow_replica delay sequence and
+    identical partition windows; a different seed diverges."""
+    from ray_tpu._private.chaos import ChaosEngine
+
+    plan = "seed=42;slow_replica:llm@1ms-20ms@0.7;route_partition:llm@1s-2s"
+    a = ChaosEngine(plan, "serve")
+    b = ChaosEngine(plan, "serve")
+    seq_a = [a.execute_delay("llm") for _ in range(40)]
+    seq_b = [b.execute_delay("llm") for _ in range(40)]
+    assert seq_a == seq_b
+    assert any(d > 0 for d in seq_a) and any(d == 0.0 for d in seq_a)
+    c = ChaosEngine(plan.replace("seed=42", "seed=43"), "serve")
+    assert [c.execute_delay("llm") for _ in range(40)] != seq_a
+    # unknown deployment never draws (and never shifts the rng)
+    d_eng = ChaosEngine(plan, "serve")
+    assert d_eng.execute_delay("other") == 0.0
+    assert [d_eng.execute_delay("llm") for _ in range(40)] == seq_a
+    # window check is pure elapsed-time arithmetic once armed
+    a.arm(now=100.0)
+    assert not a.route_partition_active("llm", now=100.5)
+    assert a.route_partition_active("llm", now=101.5)
+    assert not a.route_partition_active("llm", now=102.5)
+    assert not a.route_partition_active("other", now=101.5)

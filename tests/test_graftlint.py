@@ -1311,8 +1311,49 @@ def test_gl011_clean_heartbeat_and_flush_loops():
     assert "GL011" not in codes_of(src, path=_PRIV)
 
 
-def test_gl011_scoped_to_private():
-    assert "GL011" not in codes_of(_GL011_OLD_LOOP, path="ray_tpu/serve/x.py")
+def test_gl011_scope_covers_private_and_serve():
+    # PR 15 widened the scope: the serve plane grew its own retransmit
+    # loops (handle transparent retry, ejection re-probe), so
+    # ray_tpu/serve/ is gated alongside every _private/ package.
+    # Library/util code stays out of scope.
+    assert "GL011" in codes_of(_GL011_OLD_LOOP, path="ray_tpu/serve/x.py")
+    assert "GL011" in codes_of(
+        _GL011_OLD_LOOP, path="ray_tpu/serve/_private/x.py"
+    )
+    assert "GL011" not in codes_of(_GL011_OLD_LOOP, path="ray_tpu/util/x.py")
+
+
+def test_gl011_flags_fixed_interval_remote_reprobe():
+    # the serve resend spelling: actor_method.remote(...) re-dispatch on
+    # a fixed cadence is the same storm shape as a wire-level resend
+    src = """
+    def probe(self):
+        while self.targets:
+            self.evt.wait(0.25)
+            for replica in self.targets:
+                replica.check_health.remote()
+    """
+    assert "GL011" in codes_of(src, path="ray_tpu/serve/handle.py")
+
+
+def test_reverting_prober_fixed_cadence_is_flagged():
+    """The ejection re-probe loop in the REAL handle.py backs off with
+    delay = min(cap, delay * 2.0); flattening that growth back to a
+    fixed cadence must trip GL011 now that serve/ is in scope."""
+    handle_path = os.path.join(PKG_DIR, "serve", "handle.py")
+    with open(handle_path) as f:
+        real = f.read()
+    assert "GL011" not in {
+        f.code for f in check_file(handle_path, source=real)
+    }
+    reverted = real.replace(
+        "delay = min(cap, delay * 2.0)",
+        "delay = base",
+    )
+    assert reverted != real, "handle.py no longer matches the revert"
+    assert "GL011" in {
+        f.code for f in check_file(handle_path, source=reverted)
+    }
 
 
 def test_reverting_client_fixed_retransmit_is_flagged():

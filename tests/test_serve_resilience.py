@@ -1,0 +1,313 @@
+"""Serve resilience (PR 15): admission control / load shedding,
+deadline propagation, replica health ejection, and the drain-vs-shed
+accounting fix.
+
+Tier-1 coverage:
+  * past max_queued_requests, .remote() sheds synchronously with a
+    retriable RequestShedError; admitted requests still complete, and
+    shed/requests counters stay disjoint in summarize_serve
+  * a request deadline (handle.options(request_timeout_s=...)) bounds
+    result() — no parking on a literal 60 s wait
+  * the deadline rides request_meta into @serve.batch: an expired
+    member is dropped pre-execute (RequestExpiredError on its future)
+    WITHOUT poisoning the rest of the batch
+  * consecutive failures eject a replica from the routing candidate
+    set; success resets the streak; the transparent retry makes a
+    replica death invisible to callers
+  * the HTTP proxy maps shed -> 503 (+ Retry-After) and expired -> 504,
+    honoring the X-Request-Timeout-S per-request override
+  * drain_accounting books drained/dropped per victim (regression: the
+    old aggregate-sum double-counted when load moved between victims)
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    RequestExpiredError,
+    RequestShedError,
+)
+
+
+@pytest.fixture
+def serve_cleanup(ray_start_4_cpus):
+    yield
+    serve.shutdown()
+
+
+@pytest.fixture
+def serve_config():
+    """Save/restore the serve resilience config knobs a test overrides."""
+    from ray_tpu._private.config import RAY_TPU_CONFIG
+
+    keys = (
+        "serve_request_timeout_s", "serve_max_queued_requests",
+        "serve_ejection_failures", "serve_retry_attempts",
+        "serve_retry_base_s",
+    )
+    saved = {k: RAY_TPU_CONFIG.get(k) for k in keys}
+    yield RAY_TPU_CONFIG
+    for k, v in saved.items():
+        RAY_TPU_CONFIG.set(k, v)
+
+
+# ----------------------------------------------------- drain accounting
+
+
+def test_drain_accounting_books_per_victim():
+    """Regression for the aggregate-sum double-count: drained and
+    dropped must be booked per victim so load moving BETWEEN victims
+    during the grace window can't inflate (or deflate) either counter."""
+    from ray_tpu.serve._private.controller import drain_accounting
+
+    # clean drain: everything in-flight finished before the deadline
+    assert drain_accounting([5, 3], [0, 0]) == (8, 0)
+    # nothing drained: all of it was still running at the kill
+    assert drain_accounting([4, 2], [4, 2]) == (0, 6)
+    # mixed: one victim drained fully, the other kept 2 -> dropped
+    assert drain_accounting([5, 3], [0, 2]) == (6, 2)
+    # load GREW on one victim during the window (requests still routed
+    # to it): the gain is not "drained" — per-victim max(0, i-f) clamps
+    # it, and the final load books as dropped
+    assert drain_accounting([4, 0], [0, 2]) == (4, 2)
+    # disjointness invariant: drained + dropped never exceeds
+    # initial + arrivals, and both are non-negative
+    assert drain_accounting([], []) == (0, 0)
+
+
+# -------------------------------------------------- admission / shedding
+
+
+def test_shed_past_queue_cap(serve_cleanup, serve_config):
+    @serve.deployment(max_queued_requests=2)
+    class Slow:
+        def __call__(self, x):
+            time.sleep(1.0)
+            return x
+
+    handle = serve.run(Slow.bind())
+    # learn the cap (first request also warms the routing table)
+    assert handle.remote(0).result() == 0
+    admitted, shed = [], []
+    for i in range(6):
+        try:
+            admitted.append(handle.remote(i))
+        except RequestShedError as e:
+            shed.append(e)
+    assert shed, "no request was shed past the cap"
+    assert len(admitted) <= 2
+    first = shed[0]
+    assert first.deployment == "Slow"
+    assert first.cap == 2 and first.queued >= 2
+    # admitted requests are unaffected by the shedding around them
+    assert [r.result() for r in admitted] == list(range(len(admitted)))
+    # shed is disjoint from routed-request accounting: only admitted
+    # requests count as requests; shed rides its own counter
+    from ray_tpu.util import state as state_api
+
+    deadline = time.time() + 15
+    dep = None
+    while time.time() < deadline:
+        dep = state_api.summarize_serve()["deployments"].get("Slow")
+        if dep and dep.get("shed", 0) >= len(shed):
+            break
+        time.sleep(0.2)
+    assert dep is not None
+    assert dep["shed"] >= len(shed)
+    assert dep["requests"] == 1 + len(admitted)
+    assert dep["dropped"] == 0 and dep["drained"] == 0
+
+
+# ------------------------------------------------- deadline propagation
+
+
+def test_request_deadline_bounds_result(serve_cleanup, serve_config):
+    @serve.deployment
+    class Sleepy:
+        def __call__(self, s):
+            time.sleep(s)
+            return "done"
+
+    handle = serve.run(Sleepy.bind())
+    assert handle.remote(0).result() == "done"
+    t0 = time.monotonic()
+    resp = handle.options(request_timeout_s=0.4).remote(5.0)
+    with pytest.raises(GetTimeoutError):
+        resp.result()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 3.0, f"deadline did not bound the wait: {elapsed:.1f}s"
+    # an undeadlined sibling call on the same handle still works
+    assert handle.remote(0).result() == "done"
+
+
+def test_batch_member_deadline_drops_without_poisoning(
+    serve_cleanup, serve_config
+):
+    """Satellite: deadline propagation through @serve.batch. Member A's
+    deadline expires while it parks waiting for the batch to fill;
+    when B arrives and the batch fires, A is dropped pre-execute (the
+    user callable never sees its item) and B completes normally."""
+
+    @serve.deployment(max_ongoing_requests=8)
+    class Batched:
+        @serve.batch(max_batch_size=2, batch_wait_timeout_s=10.0)
+        async def __call__(self, items):
+            got = list(items)
+            return [{"saw": got} for _ in items]
+
+    handle = serve.run(Batched.bind())
+    resp_a = handle.options(request_timeout_s=0.5).remote("a")
+    time.sleep(1.5)  # past A's deadline; batch still waiting (size 1/2)
+    resp_b = handle.options(request_timeout_s=30.0).remote("b")
+    # B's batch executed WITHOUT the expired member
+    assert resp_b.result() == {"saw": ["b"]}
+    # A surfaces as a deadline failure (client-side timeout or the
+    # replica-side pre-execute drop, whichever wins the race)
+    with pytest.raises((GetTimeoutError, RequestExpiredError)):
+        resp_a.result()
+
+
+# ------------------------------------------------------ health ejection
+
+
+class _FakeActorId:
+    def __init__(self, b):
+        self._b = b
+
+    def binary(self):
+        return self._b
+
+
+class _FakeReplica:
+    def __init__(self, b):
+        self._actor_id = _FakeActorId(b)
+
+
+def test_ejection_streaks_unit(serve_config, monkeypatch):
+    """Router-side ejection bookkeeping, no cluster: a replica leaves
+    the candidate set after N consecutive failures; one success resets
+    its streak; options() views share the ejected set."""
+    from ray_tpu.serve.handle import DeploymentHandle
+
+    serve_config.set("serve_ejection_failures", 3)
+    monkeypatch.setattr(
+        DeploymentHandle, "_ensure_prober", lambda self: None
+    )
+    h = DeploymentHandle("D")
+    r1, r2 = _FakeReplica(b"r1"), _FakeReplica(b"r2")
+    h._replicas = [r1, r2]
+    h._note_failure(b"r1")
+    h._note_failure(b"r1")
+    assert not h._ejected  # below threshold
+    h._note_success(b"r1")  # success resets the streak
+    h._note_failure(b"r1")
+    h._note_failure(b"r1")
+    assert not h._ejected
+    h._note_failure(b"r1")  # third consecutive -> ejected
+    assert set(h._ejected) == {b"r1"}
+    assert h._ejected[b"r1"] is r1
+    # an options() view shares ejection state — it must not resurrect r1
+    view = h.options(method_name="other")
+    assert set(view._ejected) == {b"r1"}
+    # a replica unknown to the candidate set can't be ejected
+    h._note_failure(b"zz")
+    h._note_failure(b"zz")
+    h._note_failure(b"zz")
+    assert b"zz" not in h._ejected
+
+
+def test_replica_death_is_transparent(serve_cleanup, serve_config):
+    """Killing a replica mid-service stays invisible to callers: the
+    bounded transparent retry re-routes onto the survivor (ejection
+    threshold 1 pulls the corpse from the candidate set immediately)."""
+    serve_config.set("serve_ejection_failures", 1)
+
+    @serve.deployment(num_replicas=2)
+    class W:
+        def __call__(self, _):
+            return os.getpid()
+
+    handle = serve.run(W.bind())
+    pids = {handle.remote(None).result() for _ in range(12)}
+    assert len(pids) == 2
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    ctrl = ray_tpu.get_actor(CONTROLLER_NAME)
+    info = ray_tpu.get(ctrl.get_routing_info.remote("W"))
+    ray_tpu.kill(info["replicas"][0])
+    # every request still succeeds; no caller sees ActorDiedError
+    results = [handle.remote(None).result() for _ in range(12)]
+    assert all(isinstance(p, int) for p in results)
+
+
+# ------------------------------------------------------ proxy mapping
+
+
+def _urlopen_status(url, headers=None, timeout=10):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers)
+
+
+def test_http_maps_expired_to_504_and_shed_to_503(
+    serve_cleanup, serve_config
+):
+    @serve.deployment(max_queued_requests=1)
+    class Pokey:
+        def __call__(self, req):
+            time.sleep(2.0)
+            return "ok"
+
+    serve.run(Pokey.bind(), route_prefix="/pokey",
+              http_options={"port": 18769})
+    base = "http://127.0.0.1:18769/pokey"
+    # wait for the proxy route table
+    deadline = time.time() + 15
+    status = None
+    while time.time() < deadline:
+        status, _ = _urlopen_status(base, timeout=10)
+        if status != 404:
+            break
+        time.sleep(0.3)
+    assert status == 200
+    # per-request deadline override via header -> 504 well before the
+    # 2 s execute (and far before any 60 s default)
+    t0 = time.monotonic()
+    status, _ = _urlopen_status(
+        base, headers={"X-Request-Timeout-S": "0.3"}, timeout=10
+    )
+    assert status == 504
+    assert time.monotonic() - t0 < 1.9
+    # saturate the cap from background threads, then overflow -> 503
+    import threading
+
+    hold = [
+        threading.Thread(target=_urlopen_status, args=(base,),
+                         kwargs={"timeout": 30})
+        for _ in range(2)
+    ]
+    for t in hold:
+        t.start()
+    time.sleep(0.4)  # let the holders reach the replica
+    statuses = []
+    hdrs = []
+    for _ in range(4):
+        s, h = _urlopen_status(base, timeout=10)
+        statuses.append(s)
+        hdrs.append(h)
+    for t in hold:
+        t.join()
+    assert 503 in statuses, statuses
+    shed_headers = hdrs[statuses.index(503)]
+    assert shed_headers.get("Retry-After") == "1"
