@@ -857,6 +857,9 @@ class Hub:
         for conn in list(self.agent_conns):
             self._send(conn, P.KILL, {})
         self._flush_outbox()
+        # Drop pending one-shot timers: after teardown their callbacks
+        # would fire into freed worker/agent tables (GL016).
+        self.timers.clear()
 
     def _run_sharded(self):
         """State-plane main loop (n_shards > 1): reactor shards own the

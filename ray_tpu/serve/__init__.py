@@ -329,7 +329,7 @@ def shutdown() -> None:
         _proxy = None
     if _grpc_proxy is not None:
         try:
-            ray_tpu.get(_grpc_proxy.stop.remote(), timeout=5)
+            ray_tpu.get(_grpc_proxy.stop.remote(), timeout=5)  # graftlint: disable=GL017 — bounded shutdown drain, requests already rejected
         except Exception:
             pass
         try:

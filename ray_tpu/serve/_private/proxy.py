@@ -62,7 +62,7 @@ class HTTPProxy:
             target=self._serve_forever, daemon=True, name="serve-http"
         )
         self._thread.start()
-        self._ready.wait(timeout=10)
+        self._ready.wait(timeout=10)  # graftlint: disable=GL017 — pre-request startup gate; no request (hence no deadline) exists yet
 
     def _serve_forever(self):
         asyncio.set_event_loop(self._loop)

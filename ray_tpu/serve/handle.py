@@ -658,7 +658,7 @@ class DeploymentHandle:
                 try:
                     # probes are deliberately sequential: each replica
                     # gets its own verdict + bounded timeout
-                    ray_tpu.get(replica.check_health.remote(), timeout=2.0)  # graftlint: disable=GL004 — sequential health probe
+                    ray_tpu.get(replica.check_health.remote(), timeout=2.0)  # graftlint: disable=GL004,GL017 — sequential health probe with a fixed per-replica budget
                 except ActorDiedError:
                     # really dead: stop probing; the reconcile loop
                     # replaces it and _refresh prunes the rid

@@ -3,14 +3,16 @@
 Exit status: 0 when every finding is suppressed or baselined, 1 when
 new findings exist, 2 on usage errors. Findings print one per line as
 ``path:line GLxxx message`` (or as one JSON object with
-``--format json``).
+``--format json``, or as a SARIF 2.1.0 log with ``--format sarif`` for
+CI annotation uploads).
 
 ``--changed-only`` reports per-file findings only in files git
 considers changed (worktree/index vs HEAD, plus untracked) — the fast
 pre-commit mode. The whole tree is still ANALYZED, and whole-program
-findings (GL012–GL014) always report regardless of where they anchor:
+findings (GL012–GL017) always report regardless of where they anchor:
 deleting a handler must surface the sent-but-unhandled finding even
-though it anchors at the untouched send site.
+though it anchors at the untouched send site. Both structured formats
+compose with it.
 """
 
 from __future__ import annotations
@@ -39,7 +41,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         description=(
             "AST-based concurrency & distributed-runtime invariant "
             "checker for this repo: per-file rules GL001-GL011 plus "
-            "whole-program passes GL012-GL014 (see the package README)."
+            "whole-program passes GL012-GL017 (see the package README)."
         ),
     )
     parser.add_argument(
@@ -72,8 +74,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="suppress the summary line; print findings only",
     )
     parser.add_argument(
-        "--format", choices=["text", "json"], default="text",
-        help="output format (json: one object with findings + counts)",
+        "--format", choices=["text", "json", "sarif"], default="text",
+        help="output format (json: one object with findings + counts; "
+             "sarif: a SARIF 2.1.0 log for CI annotation uploads)",
     )
     parser.add_argument(
         "--changed-only", action="store_true",
@@ -161,6 +164,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         ))
         return 1 if new else 0
 
+    if args.format == "sarif":
+        print(json.dumps(_sarif_log(new), indent=2, sort_keys=True))
+        return 1 if new else 0
+
     for f in new:
         print(f.render())
     if not args.quiet:
@@ -170,6 +177,55 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
     return 1 if new else 0
+
+
+def _sarif_log(findings) -> dict:
+    """SARIF 2.1.0: the interchange format CI systems (GitHub code
+    scanning, pre-commit annotators) ingest directly. One run, one
+    result per finding; ``partialFingerprints`` carries the same
+    (path, code, symbol) identity the baseline uses, so an uploader
+    dedupes findings across pushes exactly as the baseline would."""
+    rules_seen = {}
+    results = []
+    for f in findings:
+        rules_seen.setdefault(f.code, {
+            "id": f.code,
+            "defaultConfiguration": {"level": "error"},
+        })
+        results.append({
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/"),
+                    },
+                    "region": {"startLine": max(f.line, 1)},
+                },
+            }],
+            "partialFingerprints": {
+                "graftlint/v1": f"{f.path}:{f.code}:{f.symbol}",
+            },
+        })
+    return {
+        "$schema": (
+            "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+            "master/Schemata/sarif-schema-2.1.0.json"
+        ),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftlint",
+                    "rules": [
+                        rules_seen[c] for c in sorted(rules_seen)
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
 
 
 def _git_changed_files(paths: List[str]) -> Optional[Set[str]]:

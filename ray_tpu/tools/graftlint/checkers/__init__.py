@@ -17,4 +17,7 @@ from . import (  # noqa: F401
     gl012_protocol_conformance,
     gl013_thread_ownership,
     gl014_lock_order,
+    gl015_async_discipline,
+    gl016_resource_lifecycle,
+    gl017_deadline_conformance,
 )

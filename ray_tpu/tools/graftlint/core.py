@@ -119,8 +119,9 @@ class FileContext:
 
 # --------------------------------------------------------------- parse cache
 #
-# One process-wide AST cache: 11 per-file rules plus the whole-program
-# session all want the same tree, and the tier-1 gate re-lints the full
+# One process-wide AST cache: 11 per-file rules plus the six
+# whole-program passes all want the same tree, and the tier-1 gate
+# re-lints the full
 # package several times per test run (fixtures, revert tests, the gate
 # itself). Keyed on (mtime_ns, size) so an edited fixture file re-parses
 # while untouched runtime files never do. ``parse_stats`` is exported so

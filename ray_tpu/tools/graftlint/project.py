@@ -1,11 +1,14 @@
 """Project-level analysis session for graftlint's whole-program passes.
 
 The per-file rules (GL001–GL011) see one ``FileContext`` at a time; the
-conformance/ownership/lock-order passes (GL012–GL014) need the whole
-tree at once: the wire contract lives in ``protocol.py`` but is
-*exercised* by send sites in five different processes, thread ownership
-crosses the ``hub.py``/``hub_shards.py`` module boundary, and a lock
-cycle is only visible when both acquisition orders are in the graph.
+whole-program passes (GL012–GL017) need the whole tree at once: the
+wire contract lives in ``protocol.py`` but is *exercised* by send sites
+in five different processes, thread ownership crosses the
+``hub.py``/``hub_shards.py`` module boundary, a lock cycle is only
+visible when both acquisition orders are in the graph, the sync helper
+that stalls a coroutine lives modules away from the ``async def`` that
+calls it, and a selector registered in one method is unregistered in
+another.
 
 ``ProjectSession`` wraps one shared parse of the tree (every
 ``FileContext`` comes from ``core.parse_cached``, so nothing here costs
@@ -35,7 +38,18 @@ consume:
   through the intra-class call graph, plus a light attribute-type
   inference (``self.x = Cls(...)``, ``[Cls(...) for ...]``,
   annotations) so a pass can tell that ``s`` in
-  ``for s in self._shards:`` is a ``ReactorShard``.
+  ``for s in self._shards:`` is a ``ReactorShard``;
+- the **flow model** (:meth:`ProjectSession.flow`): the project call
+  graph keyed ``module.Class.method``, with GL003's blocking tables as
+  roots (shared recognition — the per-file and whole-program notions
+  of "a blocking op" cannot diverge), locks held by thread-domain
+  methods around blocking work, trace-contextvar reads, and
+  executor/thread closure dispatches (GL015);
+- the **resource model** (:meth:`ProjectSession.resources`): per-class
+  acquire/release pairing sites — selector names (constructor-typed
+  attrs/locals plus aliases), register/unregister/close sites, timer
+  heaps and their teardown clears, and handle registries with their
+  drop paths (GL016).
 
 Everything is lazy and cached per session; a session is cheap to build
 (no parsing — the trees come from the core parse cache) and throwaway
@@ -50,7 +64,7 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
-from .core import FileContext, qualname_map, self_attr
+from .core import FileContext, dotted_name, qualname_map, self_attr
 
 __all__ = [
     "ProjectSession",
@@ -62,6 +76,10 @@ __all__ = [
     "ProtocolModel",
     "ClassThreads",
     "ThreadModel",
+    "FlowFunction",
+    "FlowModel",
+    "ResourceClass",
+    "ResourceModel",
     "session_for",
 ]
 
@@ -237,6 +255,151 @@ class ThreadModel:
         return info.domains.get(method, set())
 
 
+# ----------------------------------------------------------------- flow model
+
+
+@dataclass
+class FlowFunction:
+    """One function in the project call/blocking graph (GL015)."""
+
+    module: ModuleInfo
+    node: ast.AST                       # FunctionDef / AsyncFunctionDef
+    key: str                            # "hub.Hub._run" / "client.connect"
+    qual: str                           # module-local qualname
+    is_async: bool
+    cls_name: Optional[str] = None
+    # direct known-blocking ops in this function's own body:
+    # (line, human description)
+    blocking: List[Tuple[int, str]] = field(default_factory=list)
+    # resolved direct calls: (line, callee key, under-an-await,
+    # bare-statement)
+    calls: List[Tuple[int, str, bool, bool]] = field(default_factory=list)
+    # lockish self-attrs acquired via ``with self.X:``
+    # ("module.Class.X")
+    locks: Set[str] = field(default_factory=set)
+    # the function reads the ambient trace contextvar (directly or via
+    # begin_trace, which samples against the current context)
+    reads_trace_ctx: bool = False
+    # run_in_executor/Thread(target=) dispatches of a local closure
+    # that does NOT re-push the trace context and is not under an
+    # ``if <name> is None:`` no-trace guard: (line, closure name)
+    ctx_unsafe_dispatches: List[Tuple[int, str]] = field(
+        default_factory=list)
+
+
+@dataclass
+class FlowModel:
+    """Project-wide call graph + blocking roots (GL015).
+
+    ``functions`` is keyed ``module.Class.method`` / ``module.fn``.
+    ``slow_thread_locks`` maps a lock id ("module.Class.attr") to a
+    description of the thread-domain holder that performs a blocking op
+    while holding it — waiting on such a lock from the event loop can
+    stall for the holder's full blocking window, so acquiring one
+    counts as a blocking root for the transitive analysis.
+    """
+
+    functions: Dict[str, FlowFunction]
+    slow_thread_locks: Dict[str, str] = field(default_factory=dict)
+
+    def blocking_chain(self, key: str) -> Optional[List[str]]:
+        """["module.fn", ..., "<op description>"] for the first found
+        path from ``key`` into a blocking root; None when ``key``
+        cannot block. Memoized; cycles are cut (a cycle with no
+        blocking op on it never blocks)."""
+        memo: Dict[str, Optional[List[str]]] = self.__dict__.setdefault(
+            "_chain_memo", {})
+
+        def walk(k: str, visiting: Set[str]) -> Optional[List[str]]:
+            if k in memo:
+                return memo[k]
+            fn = self.functions.get(k)
+            if fn is None or k in visiting:
+                return None
+            visiting.add(k)
+            result: Optional[List[str]] = None
+            if fn.blocking:
+                result = [k, fn.blocking[0][1]]
+            else:
+                for lock in sorted(fn.locks):
+                    holder = self.slow_thread_locks.get(lock)
+                    if holder is not None:
+                        result = [k, f"`with {lock}:` — {holder}"]
+                        break
+            if result is None:
+                for _line, callee, awaited, _stmt in fn.calls:
+                    if awaited:
+                        continue
+                    sub_fn = self.functions.get(callee)
+                    if sub_fn is None or sub_fn.is_async:
+                        continue
+                    sub = walk(callee, visiting)
+                    if sub is not None:
+                        result = [k] + sub
+                        break
+            visiting.discard(k)
+            memo[k] = result
+            return result
+
+        return walk(key, set())
+
+
+# ------------------------------------------------------------- resource model
+
+
+# constructor/factory trailing names that hand back an owned OS-level
+# handle (or a record that must reach an emitter). The value is the
+# human-readable resource kind.
+ACQUIRE_CTORS = {
+    "mmap": "mmap segment",
+    "MappedSegment": "mmap segment",
+    "from_fd": "mmap segment",
+    "DefaultSelector": "selector",
+    "socket": "socket",
+    "create_connection": "socket",
+    "make_runtime_record": "span record",
+}
+
+# method names that release an owned handle
+RELEASE_METHODS = frozenset({"close", "unmap", "shutdown", "release",
+                             "cancel", "detach", "terminate"})
+
+
+@dataclass
+class ResourceClass:
+    """Per-class resource-lifecycle sites (GL016)."""
+
+    module: ModuleInfo
+    cls_name: str
+    qual: str                           # "hub.Hub"
+    # attrs/locals typed as selectors (assigned from DefaultSelector(),
+    # or aliased from such an attr)
+    selector_names: Set[str] = field(default_factory=set)
+    register_sites: List[int] = field(default_factory=list)
+    unregister_sites: List[int] = field(default_factory=list)
+    selector_close_sites: List[int] = field(default_factory=list)
+    # one-shot timer heaps: attr -> heappush lines
+    timer_attrs: Dict[str, List[int]] = field(default_factory=dict)
+    # attr -> clear/teardown-reassign lines
+    timer_clears: Dict[str, List[int]] = field(default_factory=dict)
+    # handle registries: attr -> store lines (``self.X[k] = handle``
+    # where the handle was acquired locally — ownership transfer)
+    registry_attrs: Dict[str, List[int]] = field(default_factory=dict)
+    # attr -> removal lines (pop / del / clear)
+    registry_drops: Dict[str, List[int]] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceModel:
+    classes: Dict[str, ResourceClass]   # keyed by qual
+
+    def resolve(self, cls_name: str) -> Optional["ResourceClass"]:
+        for info in self.classes.values():
+            if info.cls_name == cls_name:
+                return info
+        return None
+
+
 # recognized channel constructors: pushing/popping one of these crosses
 # threads by design, so the attribute itself is exempt from ownership
 # conflicts (the GL013 "ring/queue crossing")
@@ -341,6 +504,8 @@ class ProjectSession:
                 self.class_index.setdefault(name, []).append((mod, cls))
         self._protocol: Optional[ProtocolModel] = None
         self._threads: Optional[ThreadModel] = None
+        self._flow: Optional[FlowModel] = None
+        self._resources: Optional[ResourceModel] = None
 
     # ------------------------------------------------------------ module refs
     def _module_aliases(self, mod: ModuleInfo) -> Dict[str, str]:
@@ -380,6 +545,16 @@ class ProjectSession:
         if self._threads is None:
             self._threads = _build_thread_model(self)
         return self._threads
+
+    def flow(self) -> FlowModel:
+        if self._flow is None:
+            self._flow = _build_flow_model(self)
+        return self._flow
+
+    def resources(self) -> ResourceModel:
+        if self._resources is None:
+            self._resources = _build_resource_model(self)
+        return self._resources
 
     # ------------------------------------------------------------ msg resolve
     def resolve_msg(self, mod: ModuleInfo, node: ast.AST,
@@ -1428,3 +1603,389 @@ def _build_thread_model(session: ProjectSession) -> ThreadModel:
             classes[info.qual] = info
             by_name.setdefault(cls_name, []).append(info)
     return ThreadModel(classes=classes, by_name=by_name)
+
+
+# ========================================================== flow model builder
+#
+# The GL015 pass needs what no per-file rule can see: whether a SYNC
+# helper called from a coroutine eventually parks the thread. Blocking
+# recognition is shared with GL003 (same dotted table, same no-timeout
+# method forms) so the two rules' notions of "a blocking op" cannot
+# diverge; this builder adds the transitive closure over the project
+# call graph plus the slow-thread-lock roots.
+
+_TRACE_READ_CALLS = frozenset({"current_context", "begin_trace"})
+_CLOSURE_DISPATCH_THREAD = frozenset({"Thread"})
+
+
+def _local_nodes(fn: ast.AST):
+    """Nodes lexically inside ``fn``, not descending into nested
+    defs/lambdas/classes (their bodies run where they are *called*)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _callee_key(session: ProjectSession, mod: ModuleInfo,
+                cls_name: Optional[str],
+                methods: Dict[str, ast.FunctionDef],
+                call: ast.Call) -> Optional[str]:
+    """Flow-graph key of the function a call resolves to: a same-class
+    ``self.m()``, a same-module ``fn()``, a from-imported ``fn()``, or
+    a ``mod_alias.fn()`` into another session module."""
+    f = call.func
+    a = self_attr(f)
+    if a is not None:
+        if cls_name is not None and a in methods:
+            return f"{mod.basename}.{cls_name}.{a}"
+        return None
+    if isinstance(f, ast.Name):
+        if f.id in mod.functions:
+            return f"{mod.basename}.{f.id}"
+        origin = mod.ctx.import_aliases.get(f.id, "")
+        if "." in origin:
+            mpath, fname = origin.rsplit(".", 1)
+            tail = mpath.split(".")[-1]
+            for tm in session.by_basename.get(tail, []):
+                if fname in tm.functions:
+                    return f"{tm.basename}.{fname}"
+        return None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        alias = mod.module_aliases.get(f.value.id)
+        if alias is not None:
+            for tm in session.by_basename.get(alias, []):
+                if f.attr in tm.functions:
+                    return f"{tm.basename}.{f.attr}"
+    return None
+
+
+def _is_none_guard(test: ast.AST) -> bool:
+    """``<name> is None`` — the no-trace fast path: inside its body a
+    closure has no ambient context worth re-pushing."""
+    return (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.Is)
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    )
+
+
+def _calls_push_context(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _call_name(sub) == "push_context":
+            return True
+    return False
+
+
+def _unsafe_ctx_dispatches(fn: ast.AST) -> List[Tuple[int, str]]:
+    """(line, closure name) for every local lambda/nested-def handed to
+    ``run_in_executor`` / ``Thread(target=)`` without re-pushing the
+    trace context, outside an ``if <x> is None:`` no-trace guard.
+    Bound-method and partial targets are exempt: the rule exists for
+    closures written next to a live trace read (PR 13's hand-fix)."""
+    nested: Dict[str, ast.AST] = {
+        n.name: n for n in _local_nodes(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    out: List[Tuple[int, str]] = []
+
+    def closure_of(node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+        if isinstance(node, ast.Lambda):
+            return "<lambda>", node
+        if isinstance(node, ast.Name) and node.id in nested:
+            return node.id, nested[node.id]
+        return None
+
+    def check_call(call: ast.Call, guarded: bool) -> None:
+        target: Optional[ast.AST] = None
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "run_in_executor":
+            if len(call.args) >= 2:
+                target = call.args[1]
+        elif _call_name(call) in _CLOSURE_DISPATCH_THREAD:
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+        if target is None:
+            return
+        got = closure_of(target)
+        if got is None:
+            return
+        name, body = got
+        if guarded or _calls_push_context(body):
+            return
+        out.append((call.lineno, name))
+
+    def visit(node: ast.AST, guarded: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Call):
+            check_call(node, guarded)
+        if isinstance(node, ast.If) and _is_none_guard(node.test):
+            visit(node.test, guarded)
+            for s in node.body:
+                visit(s, True)
+            for s in node.orelse:
+                visit(s, guarded)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, guarded)
+
+    for stmt in ast.iter_child_nodes(fn):
+        visit(stmt, False)
+    return out
+
+
+def _build_flow_model(session: ProjectSession) -> FlowModel:
+    # shared blocking recognition — GL003's tables ARE the roots
+    from .checkers.gl003_blocking_async import (
+        BLOCKING,
+        blocking_method_form,
+        local_ctor_kinds,
+    )
+
+    functions: Dict[str, FlowFunction] = {}
+    for mod in session.modules:
+        fn_index = _FnIndex(mod)
+        for fn in _functions_in(mod.ctx.tree):
+            qual = mod.qualnames.get(id(fn), fn.name)
+            key = f"{mod.basename}.{qual}"
+            if key in functions:
+                continue  # first-hit rule, same as resolve_class
+            cls_name, _owner_fn = fn_index.owner.get(id(fn), (None, None))
+            cls = mod.classes.get(cls_name) if cls_name else None
+            methods = mod.methods(cls) if cls is not None else {}
+            ff = FlowFunction(
+                module=mod, node=fn, key=key, qual=qual,
+                is_async=isinstance(fn, ast.AsyncFunctionDef),
+                cls_name=cls_name,
+            )
+            awaited = {
+                id(sub)
+                for n in _local_nodes(fn)
+                if isinstance(n, ast.Await)
+                for sub in ast.walk(n)
+            }
+            stmt_calls = {
+                id(n.value)
+                for n in _local_nodes(fn)
+                if isinstance(n, ast.Expr) and isinstance(n.value, ast.Call)
+            }
+            kinds = local_ctor_kinds(fn)
+            for node in _local_nodes(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        a = self_attr(item.context_expr)
+                        if a is not None and is_lockish(a) and cls_name:
+                            ff.locks.add(f"{mod.basename}.{cls_name}.{a}")
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = _call_name(node)
+                if tail in _TRACE_READ_CALLS:
+                    ff.reads_trace_ctx = True
+                if id(node) not in awaited:
+                    name = mod.ctx.resolve(dotted_name(node.func))
+                    hint = BLOCKING.get(name or "")
+                    if hint is not None:
+                        ff.blocking.append(
+                            (node.lineno, f"blocking `{name}(...)`"))
+                    else:
+                        form = blocking_method_form(node, kinds)
+                        if form is not None:
+                            recv, _kind, _fix = form
+                            ff.blocking.append((
+                                node.lineno,
+                                f"no-timeout `{recv}.{node.func.attr}()`",
+                            ))
+                callee = _callee_key(session, mod, cls_name, methods, node)
+                if callee is not None:
+                    ff.calls.append((
+                        node.lineno, callee,
+                        id(node) in awaited, id(node) in stmt_calls,
+                    ))
+            if ff.reads_trace_ctx:
+                ff.ctx_unsafe_dispatches = _unsafe_ctx_dispatches(fn)
+            functions[key] = ff
+
+    # slow-thread locks: a thread-domain method that performs one of
+    # the recognized blocking ops INSIDE `with self.<lock>:` makes that
+    # lock a blocking root for everyone else
+    tm = session.threads()
+    slow: Dict[str, str] = {}
+    for key, ff in functions.items():
+        if not ff.blocking or ff.cls_name is None:
+            continue
+        cq = f"{ff.module.basename}.{ff.cls_name}"
+        info = tm.classes.get(cq)
+        if info is None:
+            continue
+        mname = ff.qual.rsplit(".", 1)[-1]
+        doms = info.domains.get(mname, set())
+        if not any(d.startswith("thread:") for d in doms):
+            continue
+        blines = [ln for ln, _d in ff.blocking]
+        for node in _local_nodes(ff.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            if not any(node.lineno <= b <= end for b in blines):
+                continue
+            for item in node.items:
+                a = self_attr(item.context_expr)
+                if a is not None and is_lockish(a):
+                    lock = f"{ff.module.basename}.{ff.cls_name}.{a}"
+                    op = next(d for ln, d in ff.blocking
+                              if node.lineno <= ln <= end)
+                    slow.setdefault(
+                        lock,
+                        f"held around {op} by {key} "
+                        f"(runs on {sorted(doms)[0]})",
+                    )
+    return FlowModel(functions=functions, slow_thread_locks=slow)
+
+
+# ====================================================== resource model builder
+
+
+def _acquire_kind(value: ast.AST) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        return ACQUIRE_CTORS.get(_call_name(value) or "")
+    return None
+
+
+def _build_resource_model(session: ProjectSession) -> ResourceModel:
+    classes: Dict[str, ResourceClass] = {}
+    for mod in session.modules:
+        for cls_name, cls in mod.classes.items():
+            rc = ResourceClass(
+                module=mod, cls_name=cls_name,
+                qual=f"{mod.basename}.{cls_name}",
+            )
+            methods = mod.methods(cls)
+            # ---- sweep A: typed names (selector ctors, timer pushes)
+            sel_names: Set[str] = set()
+            for fn in methods.values():
+                for node in _local_nodes(fn):
+                    if isinstance(node, ast.Assign):
+                        if _acquire_kind(node.value) == "selector":
+                            for t in node.targets:
+                                a = self_attr(t)
+                                if a is not None:
+                                    sel_names.add(a)
+                                elif isinstance(t, ast.Name):
+                                    sel_names.add(t.id)
+                    elif isinstance(node, ast.Call):
+                        tail = _call_name(node)
+                        if (
+                            tail in ("heappush", "append")
+                            and node.args
+                        ):
+                            a = self_attr(node.args[0]) if tail == "heappush" \
+                                else None
+                            if tail == "append":
+                                f = node.func
+                                base = (f.value if isinstance(f, ast.Attribute)
+                                        else None)
+                                a = self_attr(base) if base is not None else None
+                            if a is not None and "timer" in a.lower():
+                                rc.timer_attrs.setdefault(a, []).append(
+                                    node.lineno)
+            # ---- sweep B: aliases, pairing sites, drops, clears, stores
+            drops_raw: Dict[str, List[int]] = {}
+            clears_raw: Dict[str, List[int]] = {}
+            for mname, fn in methods.items():
+                # precollect: _local_nodes is unordered (stack walk), and
+                # the registry store may be visited before its acquire
+                acquired_locals: Set[str] = {
+                    t.id
+                    for node in _local_nodes(fn)
+                    if isinstance(node, ast.Assign)
+                    and _acquire_kind(node.value) is not None
+                    for t in node.targets
+                    if isinstance(t, ast.Name)
+                }
+                # aliases too: `sel = self._selector` before `sel.unregister`
+                for node in _local_nodes(fn):
+                    if isinstance(node, ast.Assign):
+                        va = self_attr(node.value)
+                        if va is not None and va in sel_names:
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    sel_names.add(t.id)
+                for node in _local_nodes(fn):
+                    if isinstance(node, ast.Assign):
+                        v = node.value
+                        for t in node.targets:
+                            # handle-registry store: self.X[k] = handle
+                            if (
+                                isinstance(t, ast.Subscript)
+                                and isinstance(v, ast.Name)
+                                and v.id in acquired_locals
+                            ):
+                                a = self_attr(t.value)
+                                if a is not None:
+                                    rc.registry_attrs.setdefault(
+                                        a, []).append(node.lineno)
+                            # teardown reassign: self.X = [] outside init
+                            a = self_attr(t)
+                            if (
+                                a is not None
+                                and mname != "__init__"
+                                and isinstance(node.value, (ast.List,
+                                                            ast.Dict))
+                                and not getattr(node.value, "elts", None)
+                                and not getattr(node.value, "keys", None)
+                            ):
+                                clears_raw.setdefault(a, []).append(
+                                    node.lineno)
+                    elif isinstance(node, ast.Delete):
+                        for t in node.targets:
+                            if isinstance(t, ast.Subscript):
+                                a = self_attr(t.value)
+                                if a is not None:
+                                    drops_raw.setdefault(a, []).append(
+                                        node.lineno)
+                    elif isinstance(node, ast.Call):
+                        f = node.func
+                        if not isinstance(f, ast.Attribute):
+                            continue
+                        base = f.value
+                        bname = self_attr(base) or (
+                            base.id if isinstance(base, ast.Name) else None)
+                        if bname is None:
+                            continue
+                        if f.attr in ("register", "unregister", "close") \
+                                and bname in sel_names:
+                            if f.attr == "register":
+                                rc.register_sites.append(node.lineno)
+                            elif f.attr == "unregister":
+                                rc.unregister_sites.append(node.lineno)
+                            else:
+                                rc.selector_close_sites.append(node.lineno)
+                        if f.attr in ("pop", "popitem") \
+                                and self_attr(base) is not None:
+                            drops_raw.setdefault(self_attr(base), []).append(
+                                node.lineno)
+                        if f.attr == "clear" and self_attr(base) is not None:
+                            a = self_attr(base)
+                            drops_raw.setdefault(a, []).append(node.lineno)
+                            clears_raw.setdefault(a, []).append(node.lineno)
+            rc.selector_names = sel_names
+            rc.registry_drops = {
+                a: drops_raw[a] for a in rc.registry_attrs if a in drops_raw
+            }
+            rc.timer_clears = {
+                a: clears_raw[a] for a in rc.timer_attrs if a in clears_raw
+            }
+            if (
+                rc.selector_names or rc.register_sites or rc.timer_attrs
+                or rc.registry_attrs
+            ):
+                classes[rc.qual] = rc
+    return ResourceModel(classes=classes)

@@ -86,17 +86,17 @@ def set_trace(frame=None) -> None:
     """Block this task at a breakpoint until a debugger attaches."""
     entry_uuid = _uuid.uuid4().hex[:8]
     listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    listener.bind(("0.0.0.0", 0))
-    listener.listen(1)
-    port = listener.getsockname()[1]
-    _register(entry_uuid, port)
-    print(
-        f"ray_tpu breakpoint {entry_uuid} waiting on port {port} "
-        f"(pid={os.getpid()}); attach with ray_tpu.util.rpdb.connect()",
-        file=sys.stderr,
-    )
     try:
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("0.0.0.0", 0))
+        listener.listen(1)
+        port = listener.getsockname()[1]
+        _register(entry_uuid, port)
+        print(
+            f"ray_tpu breakpoint {entry_uuid} waiting on port {port} "
+            f"(pid={os.getpid()}); attach with ray_tpu.util.rpdb.connect()",
+            file=sys.stderr,
+        )
         conn, _ = listener.accept()
     finally:
         listener.close()
@@ -163,22 +163,23 @@ def connect(
             raise RuntimeError(f"breakpoint {breakpoint_uuid} not found")
     meta = bps[0]
     sock = socket.create_connection((meta["host"], meta["port"]), timeout=30)
-    stdin = stdin if stdin is not None else sys.stdin
-    stdout = stdout if stdout is not None else sys.stdout
-    fh = sock.makefile("rw", buffering=1)
-    import threading
-
-    def _pump_out():
-        try:
-            for line in fh:
-                stdout.write(line)
-                stdout.flush()
-        except (OSError, ValueError):
-            pass
-
-    t = threading.Thread(target=_pump_out, daemon=True)
-    t.start()
+    t = None
     try:
+        stdin = stdin if stdin is not None else sys.stdin
+        stdout = stdout if stdout is not None else sys.stdout
+        fh = sock.makefile("rw", buffering=1)
+        import threading
+
+        def _pump_out():
+            try:
+                for line in fh:
+                    stdout.write(line)
+                    stdout.flush()
+            except (OSError, ValueError):
+                pass
+
+        t = threading.Thread(target=_pump_out, daemon=True)
+        t.start()
         for line in stdin:
             try:
                 fh.write(line)
@@ -189,7 +190,8 @@ def connect(
         # Drain remaining debugger output first: the remote end closes
         # the socket when the session finishes (continue/quit), which
         # ends the pump; closing before that loses the tail.
-        t.join(timeout=10)
+        if t is not None:
+            t.join(timeout=10)
         try:
             sock.close()
         except OSError:

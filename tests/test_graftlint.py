@@ -32,6 +32,40 @@ def codes_of(source, path="fixture.py"):
     return sorted({f.code for f in check_file(path, source=textwrap.dedent(source))})
 
 
+# Shared scaffolding: every rule family repeats the same three moves —
+# run the CLI as a subprocess, materialize a throwaway fixture tree, or
+# re-apply a historical defect to the REAL source and lint the modified
+# copy against the rest of the live tree. Keep each shape in ONE place.
+
+
+def run_cli(*args, cwd=REPO_ROOT):
+    """Run ``python -m ray_tpu.tools.graftlint`` exactly as CI would."""
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.tools.graftlint", *map(str, args)],
+        capture_output=True,
+        text=True,
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        cwd=str(cwd),
+    )
+
+
+def live_revert(rel_path, old, new, codes):
+    """Fresh findings after replacing ``old`` with ``new`` in the real
+    ``ray_tpu/<rel_path>`` (analyzed via overrides — disk untouched,
+    every other file live). Asserts the anchor text still exists, so a
+    refactor that silently invalidates the revert fails loudly instead
+    of testing nothing."""
+    path = os.path.join(PKG_DIR, *rel_path.split("/"))
+    with open(path) as f:
+        real = f.read()
+    reverted = real.replace(old, new)
+    assert reverted != real, f"{rel_path} no longer matches the revert"
+    fresh, _ = check_paths(
+        [PKG_DIR], overrides={path: reverted}, codes=set(codes)
+    )
+    return fresh
+
+
 # --------------------------------------------------------------------- GL001
 
 
@@ -770,60 +804,34 @@ def test_cli_exit_codes(tmp_path):
     bad.write_text("def fire(actor):\n    actor.ping.remote()\n")
     good = tmp_path / "good.py"
     good.write_text("def add(a, b):\n    return a + b\n")
-    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
 
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(good)],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(good)
     assert r.returncode == 0, r.stdout + r.stderr
 
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad)],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(bad)
     assert r.returncode == 1
     assert "GL004" in r.stdout
 
     # --write-baseline accepts the findings; a rerun against it is clean
     bl = tmp_path / "bl.json"
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad),
-         "--write-baseline", str(bl)],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(bad, "--write-baseline", bl)
     assert r.returncode == 0
     assert json.loads(bl.read_text())["entries"]
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad),
-         "--baseline", str(bl)],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(bad, "--baseline", bl)
     assert r.returncode == 0
 
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint",
-         str(tmp_path / "missing.py")],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(tmp_path / "missing.py")
     assert r.returncode == 2
 
     # a typo'd --select must not silently run zero checkers and pass
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad),
-         "--select", "GL04"],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(bad, "--select", "GL04")
     assert r.returncode == 2
     assert "unknown rule code" in r.stderr
 
     # an explicitly-named file is linted even without a .py extension
     script = tmp_path / "worker_script"
     script.write_text(bad.read_text())
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(script)],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(script)
     assert r.returncode == 1
     assert "GL004" in r.stdout
 
@@ -1193,20 +1201,13 @@ def test_reverting_shard_direct_disconnect_is_flagged():
     racing the state plane over every registry the cleanup touches.
     The shipped shape pushes a CONN_LOST message instead. Re-applying
     the direct call to the REAL hub_shards.py source must trip GL010."""
-    shards_path = os.path.join(PKG_DIR, "_private", "hub_shards.py")
-    with open(shards_path) as f:
-        real = f.read()
-    assert "GL010" not in {
-        f.code for f in check_file(shards_path, source=real)
-    }
-    reverted = real.replace(
+    fresh = live_revert(
+        "_private/hub_shards.py",
         "self._state_ring.push((conn, None, CONN_LOST, None))",
         "self.hub._handle_disconnect(conn)",
+        codes={"GL010"},
     )
-    assert reverted != real, "hub_shards.py no longer matches the revert"
-    assert "GL010" in {
-        f.code for f in check_file(shards_path, source=reverted)
-    }
+    assert "GL010" in {f.code for f in fresh}, [f.render() for f in fresh]
 
 
 # --------------------------------------------------------------------- GL011
@@ -1340,20 +1341,13 @@ def test_reverting_prober_fixed_cadence_is_flagged():
     """The ejection re-probe loop in the REAL handle.py backs off with
     delay = min(cap, delay * 2.0); flattening that growth back to a
     fixed cadence must trip GL011 now that serve/ is in scope."""
-    handle_path = os.path.join(PKG_DIR, "serve", "handle.py")
-    with open(handle_path) as f:
-        real = f.read()
-    assert "GL011" not in {
-        f.code for f in check_file(handle_path, source=real)
-    }
-    reverted = real.replace(
+    fresh = live_revert(
+        "serve/handle.py",
         "delay = min(cap, delay * 2.0)",
         "delay = base",
+        codes={"GL011"},
     )
-    assert reverted != real, "handle.py no longer matches the revert"
-    assert "GL011" in {
-        f.code for f in check_file(handle_path, source=reverted)
-    }
+    assert "GL011" in {f.code for f in fresh}, [f.render() for f in fresh]
 
 
 def test_reverting_client_fixed_retransmit_is_flagged():
@@ -1362,20 +1356,13 @@ def test_reverting_client_fixed_retransmit_is_flagged():
     shipped fix draws each wait from _retry_delay (capped exponential
     backoff + jitter); re-applying the fixed-period wait to the REAL
     client.py source must trip GL011."""
-    client_path = os.path.join(PKG_DIR, "_private", "client.py")
-    with open(client_path) as f:
-        real = f.read()
-    assert "GL011" not in {
-        f.code for f in check_file(client_path, source=real)
-    }
-    reverted = real.replace(
+    fresh = live_revert(
+        "_private/client.py",
         "remaining, delay = self._retry_delay(delay)",
         "remaining = self._RETRY_PERIOD_S",
+        codes={"GL011"},
     )
-    assert reverted != real, "client.py no longer matches the revert"
-    assert "GL011" in {
-        f.code for f in check_file(client_path, source=reverted)
-    }
+    assert "GL011" in {f.code for f in fresh}, [f.render() for f in fresh]
 
 
 # ------------------------------------------------------------- repo gate
@@ -1407,7 +1394,9 @@ def test_every_checker_is_exercised_by_the_gate_config():
     # builds one ProjectSession over the package and runs them after
     # the per-file rules)
     pcodes = {code for code, _name, _fn in all_project_checkers()}
-    assert pcodes == {"GL012", "GL013", "GL014"}
+    assert pcodes == {
+        "GL012", "GL013", "GL014", "GL015", "GL016", "GL017",
+    }
 
 
 # --------------------------------------------------------------------- GL012
@@ -1422,7 +1411,11 @@ def project_findings(tmp_path, files, codes):
     d = tmp_path / "proj"
     d.mkdir(exist_ok=True)
     for name, src in files.items():
-        (d / name).write_text(textwrap.dedent(src))
+        # names may carry directories ("ray_tpu/serve/app.py") for
+        # path-scoped passes like GL017
+        target = d / name
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(textwrap.dedent(src))
     new, _old = check_paths([str(d)], codes=set(codes))
     return new
 
@@ -1918,21 +1911,16 @@ def test_reverting_node_agent_worker_id_read_is_flagged():
     shipped a top-level 'worker_id' the node agent never read (it dug
     the id out of the env dict instead) — dead wire weight invisible
     per-file. Re-applying the env-dict read must trip GL012."""
-    agent_path = os.path.join(PKG_DIR, "_private", "node_agent.py")
-    with open(agent_path) as f:
-        real = f.read()
-    reverted = real.replace(
+    fresh = live_revert(
+        "_private/node_agent.py",
         'self.children[p["worker_id"]] = proc',
         'self.children[p["env"]["RAY_TPU_WORKER_ID"]] = proc',
-    )
-    assert reverted != real, "node_agent.py no longer matches the revert"
-    new, _ = check_paths(
-        [PKG_DIR], overrides={agent_path: reverted}, codes={"GL012"},
+        codes={"GL012"},
     )
     assert any(
         f.symbol == "<protocol>.spawn_worker.worker_id.never_read"
-        for f in new
-    ), [f.render() for f in new]
+        for f in fresh
+    ), [f.render() for f in fresh]
 
 
 def test_reverting_shard_direct_disconnect_trips_gl013_too():
@@ -1941,20 +1929,16 @@ def test_reverting_shard_direct_disconnect_trips_gl013_too():
     hub._handle_disconnect(conn) from the shard thread instead of
     pushing CONN_LOST onto the state ring. GL013 must flag it WITHOUT
     GL010's hand-labelled base names — purely from domain inference."""
-    shards_path = os.path.join(PKG_DIR, "_private", "hub_shards.py")
-    with open(shards_path) as f:
-        real = f.read()
-    reverted = real.replace(
+    fresh = live_revert(
+        "_private/hub_shards.py",
         "self._state_ring.push((conn, None, CONN_LOST, None))",
         "self.hub._handle_disconnect(conn)",
-    )
-    assert reverted != real, "hub_shards.py no longer matches the revert"
-    new, _ = check_paths(
-        [PKG_DIR], overrides={shards_path: reverted}, codes={"GL013"},
+        codes={"GL013"},
     )
     assert any(
-        f.code == "GL013" and "_handle_disconnect" in f.symbol for f in new
-    ), [f.render() for f in new]
+        f.code == "GL013" and "_handle_disconnect" in f.symbol
+        for f in fresh
+    ), [f.render() for f in fresh]
 
 
 def test_inverting_client_lock_order_is_flagged():
@@ -1981,15 +1965,15 @@ def test_inverting_client_lock_order_is_flagged():
         "                pool = self._agent_pool.get(endpoint)\n",
     )
     assert reverted != real, "client.py no longer matches the revert"
-    new, _ = check_paths(
+    fresh, _ = check_paths(
         [PKG_DIR], overrides={client_path: reverted}, codes={"GL014"},
     )
     assert any(
         f.code == "GL014"
         and "_obj_cache_lock" in f.message
         and "_agent_pool_lock" in f.message
-        for f in new
-    ), [f.render() for f in new]
+        for f in fresh
+    ), [f.render() for f in fresh]
 
 
 # ------------------------------------------------------- analysis session
@@ -2063,7 +2047,7 @@ def test_thread_model_seeds_the_documented_entry_points():
 
 
 def test_parse_cache_one_parse_per_file_and_no_rescan_regression():
-    """The perf satellite: all 14 checkers (11 per-file + 3 whole-
+    """The perf satellite: all 17 checkers (11 per-file + 6 whole-
     program) share ONE parse of each file, a second full-tree run
     re-parses nothing, and the cached run is not slower than the
     parse-paying run despite the added whole-program passes."""
@@ -2092,7 +2076,7 @@ def test_parse_cache_one_parse_per_file_and_no_rescan_regression():
     t_warm = _time.monotonic() - t0
     assert parse_stats["parses"] == p1, "warm run re-parsed files"
     assert parse_stats["hits"] - h1 == n_files
-    # the cache must actually pay: a full 14-checker warm run beats the
+    # the cache must actually pay: a full 17-checker warm run beats the
     # cold run that had to parse (1.1 slack absorbs box noise)
     assert t_warm < t_cold * 1.1, (t_cold, t_warm)
     # absolute backstop so a pathological whole-program blowup fails
@@ -2106,12 +2090,7 @@ def test_parse_cache_one_parse_per_file_and_no_rescan_regression():
 def test_cli_json_format(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text("def fire(actor):\n    actor.ping.remote()\n")
-    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(bad),
-         "--format", "json"],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(bad, "--format", "json")
     assert r.returncode == 1
     data = json.loads(r.stdout)
     assert data["baselined"] == 0 and data["changed_only"] is False
@@ -2121,11 +2100,7 @@ def test_cli_json_format(tmp_path):
 
     good = tmp_path / "good.py"
     good.write_text("def add(a, b):\n    return a + b\n")
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(good),
-         "--format", "json"],
-        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
-    )
+    r = run_cli(good, "--format", "json")
     assert r.returncode == 0
     assert json.loads(r.stdout)["findings"] == []
 
@@ -2133,7 +2108,6 @@ def test_cli_json_format(tmp_path):
 def test_cli_changed_only_scopes_reporting_to_the_git_diff(tmp_path):
     repo = tmp_path / "repo"
     repo.mkdir()
-    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
 
     def git(*cmd):
         r = subprocess.run(
@@ -2154,11 +2128,7 @@ def test_cli_changed_only_scopes_reporting_to_the_git_diff(tmp_path):
     fresh = repo / "fresh.py"
     fresh.write_text("def fire(actor):\n    actor.ping.remote()\n")
 
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(repo),
-         "--changed-only", "--format", "json"],
-        capture_output=True, text=True, env=env, cwd=str(repo),
-    )
+    r = run_cli(repo, "--changed-only", "--format", "json", cwd=repo)
     assert r.returncode == 1, r.stdout + r.stderr
     data = json.loads(r.stdout)
     paths = {f["path"] for f in data["findings"]}
@@ -2171,18 +2141,11 @@ def test_cli_changed_only_scopes_reporting_to_the_git_diff(tmp_path):
     # reported (the committed bug still exists — full runs see it)
     git("add", "fresh.py")
     git("commit", "-qm", "fresh")
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(repo),
-         "--changed-only", "--format", "json"],
-        capture_output=True, text=True, env=env, cwd=str(repo),
-    )
+    r = run_cli(repo, "--changed-only", "--format", "json", cwd=repo)
     assert r.returncode == 0, r.stdout + r.stderr
     assert json.loads(r.stdout)["findings"] == []
 
-    r = subprocess.run(
-        [sys.executable, "-m", "ray_tpu.tools.graftlint", str(repo)],
-        capture_output=True, text=True, env=env, cwd=str(repo),
-    )
+    r = run_cli(repo, cwd=repo)
     assert r.returncode == 1  # full run still reports both
 
 
@@ -2301,3 +2264,611 @@ def test_changed_only_keeps_whole_program_findings(tmp_path):
         [str(d)], codes={"GL004", "GL012"}, report_only={str(hub)},
     )
     assert not any(f.code == "GL004" for f in new2)
+
+
+# --------------------------------------------------------------------- GL015
+#
+# Async discipline is a whole-program property: the coroutine that
+# stalls the loop never says `sleep` itself — a sync helper two calls
+# away does. All fixtures run through the session (project_findings).
+
+
+GL015_TRANSITIVE = """
+import asyncio
+import time
+
+
+def _backoff():
+    time.sleep(0.5)
+
+
+def _retry():
+    _backoff()
+
+
+class Server:
+    async def handle(self, req):
+        _retry()
+        return req
+"""
+
+
+def test_gl015_flags_transitively_blocking_sync_helper(tmp_path):
+    fresh = project_findings(tmp_path, {"app.py": GL015_TRANSITIVE},
+                             codes={"GL015"})
+    hits = [f for f in fresh if f.symbol.endswith("._retry.blocking")]
+    assert hits, [f.render() for f in fresh]
+    # the message names the whole chain, not just the first hop
+    assert "_backoff" in hits[0].message and "time.sleep" in hits[0].message
+
+
+def test_gl015_blocking_root_crosses_modules(tmp_path):
+    # the helper lives in another module and parks on a no-timeout
+    # future (GL003's method-form table seeds the roots)
+    fresh = project_findings(tmp_path, {
+        "pool.py": """
+        from concurrent.futures import ThreadPoolExecutor
+
+        _POOL = ThreadPoolExecutor(2)
+
+        def run_sync(fn):
+            fut = _POOL.submit(fn)
+            return fut.result()
+        """,
+        "app.py": """
+        from pool import run_sync
+
+        class Server:
+            async def handle(self, req):
+                return run_sync(req)
+        """,
+    }, codes={"GL015"})
+    assert any(
+        f.symbol == "Server.handle.pool.run_sync.blocking" for f in fresh
+    ), [f.render() for f in fresh]
+
+
+def test_gl015_clean_when_helper_runs_in_executor(tmp_path):
+    fresh = project_findings(tmp_path, {"app.py": """
+    import asyncio
+    import time
+
+
+    def _backoff():
+        time.sleep(0.5)
+
+
+    class Server:
+        async def handle(self, req):
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, _backoff)
+            return req
+    """}, codes={"GL015"})
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_gl015_flags_lock_shared_with_slow_thread(tmp_path):
+    # the sync helper never blocks — but it takes a lock a worker
+    # thread holds around time.sleep, so the loop can stall for the
+    # holder's whole window
+    fresh = project_findings(tmp_path, {"mixed.py": """
+    import threading
+    import time
+
+
+    class Mixed:
+        def __init__(self):
+            self._lock = threading.Lock()
+            threading.Thread(target=self._worker, daemon=True).start()
+
+        def _worker(self):
+            while True:
+                with self._lock:
+                    time.sleep(1.0)
+
+        def _peek(self):
+            with self._lock:
+                return 1
+
+        async def view(self):
+            return self._peek()
+    """}, codes={"GL015"})
+    hits = [f for f in fresh if f.symbol.endswith("._peek.blocking")]
+    assert hits, [f.render() for f in fresh]
+    assert "_lock" in hits[0].message
+
+
+def test_gl015_flags_never_awaited_coroutine(tmp_path):
+    fresh = project_findings(tmp_path, {"app.py": """
+    class Server:
+        async def _notify(self):
+            pass
+
+        async def handle(self):
+            self._notify()
+    """}, codes={"GL015"})
+    assert any(
+        f.symbol.endswith("._notify.never_awaited") for f in fresh
+    ), [f.render() for f in fresh]
+
+
+def test_gl015_awaited_or_stored_coroutines_are_clean(tmp_path):
+    fresh = project_findings(tmp_path, {"app.py": """
+    import asyncio
+
+
+    class Server:
+        async def _notify(self):
+            pass
+
+        async def handle(self):
+            await self._notify()
+            task = asyncio.create_task(self._notify())
+            return task
+    """}, codes={"GL015"})
+    assert fresh == [], [f.render() for f in fresh]
+
+
+GL015_CTX_DROP = """
+import asyncio
+from ray_tpu.util import tracing as _tracing
+
+
+class Proxy:
+    async def handle(self, req, handle):
+        tr = _tracing.current_context()
+        loop = asyncio.get_running_loop()
+
+        def _routed():
+            return handle.remote(req).result()
+
+        return await loop.run_in_executor(None, _routed)
+"""
+
+
+def test_gl015_flags_context_dropping_dispatch(tmp_path):
+    fresh = project_findings(tmp_path, {"proxy.py": GL015_CTX_DROP},
+                             codes={"GL015"})
+    assert any(
+        f.symbol == "Proxy.handle._routed.ctx_dropped" for f in fresh
+    ), [f.render() for f in fresh]
+
+
+def test_gl015_ctx_repush_and_none_guard_are_clean(tmp_path):
+    # PR 13's shipped shape: re-push inside the closure; the no-trace
+    # fast path under `if tr is None:` has nothing to propagate
+    fresh = project_findings(tmp_path, {"proxy.py": """
+    import asyncio
+    from ray_tpu.util import tracing as _tracing
+
+
+    class Proxy:
+        async def handle(self, req, handle):
+            tr = _tracing.current_context()
+            loop = asyncio.get_running_loop()
+            if tr is None:
+                return await loop.run_in_executor(
+                    None, lambda: handle.remote(req).result()
+                )
+
+            def _routed():
+                token = _tracing.push_context(tr)
+                try:
+                    return handle.remote(req).result()
+                finally:
+                    _tracing.pop_context(token)
+
+            return await loop.run_in_executor(None, _routed)
+    """}, codes={"GL015"})
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_reverting_proxy_context_repush_is_flagged():
+    """PR 13's hand-fix as a permanent rule: the proxy's sticky-routing
+    closure re-pushes the ambient trace context before running on the
+    executor thread. Stripping the re-push from the REAL proxy.py must
+    trip GL015's ctx_dropped arm."""
+    fresh = live_revert(
+        "serve/_private/proxy.py",
+        "                def _routed():\n"
+        "                    token = _tracing.push_context((tr[0], proxy_sid))\n"
+        "                    try:\n"
+        "                        return handle.remote(req).result()\n"
+        "                    finally:\n"
+        "                        _tracing.pop_context(token)\n",
+        "                def _routed():\n"
+        "                    return handle.remote(req).result()\n",
+        codes={"GL015"},
+    )
+    assert any(
+        f.symbol == "HTTPProxy._handle._routed.ctx_dropped" for f in fresh
+    ), [f.render() for f in fresh]
+
+
+# --------------------------------------------------------------------- GL016
+#
+# Resource lifecycle: leaks are invisible per-file because ownership
+# legitimately moves around — into registries, out via returns. The
+# escape analysis has to see the whole function; the class layer the
+# whole class.
+
+
+def test_gl016_flags_handle_never_released(tmp_path):
+    fresh = project_findings(tmp_path, {"store.py": """
+    import mmap
+
+
+    def leak(n):
+        seg = mmap.mmap(-1, n)
+        return n
+    """}, codes={"GL016"})
+    assert any(
+        f.symbol == "leak.seg.unreleased" for f in fresh
+    ), [f.render() for f in fresh]
+
+
+def test_gl016_flags_raising_call_between_acquire_and_release(tmp_path):
+    fresh = project_findings(tmp_path, {"store.py": """
+    import mmap
+
+
+    def risky(fd, n, meta):
+        seg = mmap.mmap(fd, n)
+        validate(meta)
+        seg.close()
+
+
+    def validate(meta):
+        if not meta:
+            raise ValueError(meta)
+    """}, codes={"GL016"})
+    assert any(
+        f.symbol == "risky.seg.leak_on_raise" for f in fresh
+    ), [f.render() for f in fresh]
+
+
+def test_gl016_release_transfer_and_tryfinally_are_clean(tmp_path):
+    # every sanctioned resolution: close in finally, store into a
+    # tracked registry (with a drop path), return to caller, context
+    # manager, hand-off to another call
+    fresh = project_findings(tmp_path, {"store.py": """
+    import mmap
+
+
+    class Store:
+        def __init__(self):
+            self._segments = {}
+
+        def put(self, name, fd, n, meta):
+            seg = mmap.mmap(fd, n)
+            try:
+                validate(meta)
+            except ValueError:
+                seg.close()
+                raise
+            self._segments[name] = seg
+
+        def drop(self, name):
+            seg = self._segments.pop(name, None)
+            if seg is not None:
+                seg.close()
+
+
+    def guarded(fd, n, meta):
+        seg = mmap.mmap(fd, n)
+        try:
+            validate(meta)
+        finally:
+            seg.close()
+
+
+    def handoff(fd, n):
+        seg = mmap.mmap(fd, n)
+        return seg
+
+
+    def scoped(path):
+        with open(path) as f:
+            return f.read()
+
+
+    def validate(meta):
+        if not meta:
+            raise ValueError(meta)
+    """}, codes={"GL016"})
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_gl016_flags_selector_without_unregister(tmp_path):
+    fresh = project_findings(tmp_path, {"reactor.py": """
+    import selectors
+
+
+    class Reactor:
+        def start(self, sock):
+            self._sel = selectors.DefaultSelector()
+            self._sel.register(sock, selectors.EVENT_READ)
+    """}, codes={"GL016"})
+    symbols = {f.symbol for f in fresh}
+    assert "reactor.Reactor.selector.unregister_missing" in symbols, symbols
+    assert "reactor.Reactor.selector.close_missing" in symbols, symbols
+
+
+def test_gl016_selector_with_full_lifecycle_is_clean(tmp_path):
+    fresh = project_findings(tmp_path, {"reactor.py": """
+    import selectors
+
+
+    class Reactor:
+        def start(self, sock):
+            self._sel = selectors.DefaultSelector()
+            self._sel.register(sock, selectors.EVENT_READ)
+
+        def drop(self, sock):
+            sel = self._sel
+            sel.unregister(sock)
+
+        def stop(self):
+            self._sel.close()
+    """}, codes={"GL016"})
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_gl016_flags_timers_without_teardown_clear(tmp_path):
+    src = """
+    import heapq
+
+
+    class Hub:
+        def __init__(self):
+            self.timers = []
+
+        def _add_timer(self, deadline, cb):
+            heapq.heappush(self.timers, (deadline, cb))
+    {teardown}
+    """
+    fresh = project_findings(tmp_path, {
+        "hub.py": src.format(teardown=""),
+    }, codes={"GL016"})
+    assert any(
+        f.symbol == "hub.Hub.timers.teardown_clear_missing" for f in fresh
+    ), [f.render() for f in fresh]
+
+    fresh = project_findings(tmp_path, {
+        "hub.py": src.format(teardown="""
+        def teardown(self):
+            self.timers.clear()"""),
+    }, codes={"GL016"})
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_gl016_flags_registry_without_drop_path(tmp_path):
+    fresh = project_findings(tmp_path, {"store.py": """
+    import mmap
+
+
+    class Store:
+        def __init__(self):
+            self._segments = {}
+
+        def put(self, name, fd, n):
+            seg = mmap.mmap(fd, n)
+            self._segments[name] = seg
+    """}, codes={"GL016"})
+    assert any(
+        f.symbol == "store.Store._segments.drop_missing" for f in fresh
+    ), [f.render() for f in fresh]
+
+
+def test_gl016_flags_span_record_never_emitted(tmp_path):
+    # span open/emit pairing rides the same escape analysis: a record
+    # built and dropped never reaches the collector
+    fresh = project_findings(tmp_path, {"obs.py": """
+    def make_runtime_record(kind):
+        return {"kind": kind}
+
+
+    def _emit(record):
+        pass
+
+
+    def bad(kind):
+        rec = make_runtime_record(kind)
+        return 1
+
+
+    def good(kind):
+        rec = make_runtime_record(kind)
+        _emit(rec)
+    """}, codes={"GL016"})
+    symbols = {f.symbol for f in fresh}
+    assert "bad.rec.unreleased" in symbols, symbols
+    assert not any(s.startswith("good.") for s in symbols), symbols
+
+
+def test_reverting_hub_disconnect_unregister_is_flagged():
+    """The real lifecycle the class layer guards: hub's disconnect path
+    unregisters the dead conn from the selector. Replacing that call
+    with `pass` in the REAL hub.py leaves registration with no
+    unregister anywhere in the class — GL016 must flag it."""
+    fresh = live_revert(
+        "_private/hub.py",
+        "sel.unregister(conn)",
+        "pass",
+        codes={"GL016"},
+    )
+    assert any(
+        f.symbol == "hub.Hub.selector.unregister_missing" for f in fresh
+    ), [f.render() for f in fresh]
+
+
+def test_gl016_resource_model_resolves_real_acquire_sites():
+    """Satellite: the model must keep tracking the three live acquire
+    families this rule exists for — hub's selector + one-shot timers,
+    the shard reactor's selector, and the object store's mapping table
+    (stores AND drops). A refactor that renames these out from under
+    the model silently disables the rule; this pins the resolution."""
+    from ray_tpu.tools.graftlint.core import iter_python_files, parse_cached
+    from ray_tpu.tools.graftlint.project import ProjectSession
+
+    ctxs = [parse_cached(p) for p in iter_python_files([PKG_DIR])]
+    rm = ProjectSession([c for c in ctxs if c is not None]).resources()
+
+    hub = rm.classes["hub.Hub"]
+    assert hub.register_sites and hub.unregister_sites
+    assert hub.selector_close_sites
+    assert "timers" in hub.timer_attrs
+    assert "timers" in hub.timer_clears  # the _teardown_runtime clear
+
+    shard = rm.classes["hub_shards.ReactorShard"]
+    assert shard.register_sites and shard.unregister_sites
+
+    store = rm.classes["object_store.ShmObjectStore"]
+    assert "_segments" in store.registry_attrs  # mapping table stores
+    assert "_segments" in store.registry_drops  # drop_mapping/free
+
+
+# --------------------------------------------------------------------- GL017
+#
+# Deadline conformance is path-scoped: the contract only binds the
+# serve plane, so fixtures materialize a ray_tpu/serve/ subtree.
+
+
+GL017_LITERALS = """
+import asyncio
+
+
+class Handle:
+    def fetch(self, fut, evt, q):
+        fut.result(timeout=30.0)
+        evt.wait(5)
+        q.get(timeout=2.0)
+
+    async def awaited(self, coro):
+        return await asyncio.wait_for(coro, 10.0)
+"""
+
+
+def test_gl017_flags_literal_timeouts_in_serve(tmp_path):
+    fresh = project_findings(
+        tmp_path, {"ray_tpu/serve/app.py": GL017_LITERALS},
+        codes={"GL017"},
+    )
+    symbols = {f.symbol for f in fresh}
+    assert symbols == {
+        "Handle.fetch.result.literal_timeout",
+        "Handle.fetch.wait.literal_timeout",
+        "Handle.fetch.get.literal_timeout",
+        "Handle.awaited.wait_for.literal_timeout",
+    }, symbols
+
+
+def test_gl017_derived_zero_and_dict_get_are_clean(tmp_path):
+    fresh = project_findings(tmp_path, {"ray_tpu/serve/app.py": """
+    import asyncio
+
+
+    class Handle:
+        def fetch(self, fut, meta, cfg):
+            remaining = meta.remaining_s()
+            fut.result(timeout=remaining)
+            return cfg.get("retries", 5)
+
+        def poll(self, evt):
+            return evt.wait(timeout=0)
+
+        async def awaited(self, coro, meta):
+            return await asyncio.wait_for(coro, meta.remaining_s())
+    """}, codes={"GL017"})
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_gl017_is_scoped_to_the_serve_plane(tmp_path):
+    # the identical source outside ray_tpu/serve/ is out of contract
+    fresh = project_findings(
+        tmp_path, {"ray_tpu/_private/other.py": GL017_LITERALS},
+        codes={"GL017"},
+    )
+    assert fresh == [], [f.render() for f in fresh]
+
+
+def test_reverting_handle_deadline_derivation_is_flagged():
+    """PR 15's deadline contract: the response-await path computes its
+    wait_for bound from the request deadline. Hard-coding the literal
+    30s back into the REAL handle.py must trip GL017."""
+    fresh = live_revert(
+        "serve/handle.py",
+        "timeout=remaining",
+        "timeout=30.0",
+        codes={"GL017"},
+    )
+    assert any(
+        f.symbol == "DeploymentResponse.__await__._get.wait_for"
+                    ".literal_timeout"
+        for f in fresh
+    ), [f.render() for f in fresh]
+
+
+# --------------------------------------------------------------------- sarif
+
+
+def test_cli_sarif_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def fire(actor):\n    actor.ping.remote()\n")
+    r = run_cli(bad, "--format", "sarif")
+    assert r.returncode == 1
+    log = json.loads(r.stdout)
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    assert [rule["id"] for rule in run["tool"]["driver"]["rules"]] == [
+        "GL004"
+    ]
+    res = run["results"][0]
+    assert res["ruleId"] == "GL004"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("bad.py")
+    assert loc["region"]["startLine"] == 2
+    # the fingerprint carries the baseline identity, so an uploader
+    # dedupes across pushes the same way the baseline would
+    assert res["partialFingerprints"]["graftlint/v1"].endswith(
+        ":GL004:fire.discarded"
+    )
+
+    good = tmp_path / "good.py"
+    good.write_text("def add(a, b):\n    return a + b\n")
+    r = run_cli(good, "--format", "sarif")
+    assert r.returncode == 0
+    assert json.loads(r.stdout)["runs"][0]["results"] == []
+
+
+def test_cli_sarif_composes_with_changed_only(tmp_path):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*cmd):
+        r = subprocess.run(
+            ["git", "-C", str(repo), "-c", "user.email=t@t",
+             "-c", "user.name=t", *cmd],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        return r.stdout
+
+    git("init", "-q")
+    committed = repo / "committed.py"
+    committed.write_text("def fire(actor):\n    actor.ping.remote()\n")
+    git("add", "committed.py")
+    git("commit", "-qm", "seed")
+    fresh = repo / "fresh.py"
+    fresh.write_text("def fire(actor):\n    actor.ping.remote()\n")
+
+    r = run_cli(repo, "--changed-only", "--format", "sarif", cwd=repo)
+    assert r.returncode == 1, r.stdout + r.stderr
+    results = json.loads(r.stdout)["runs"][0]["results"]
+    uris = {
+        res["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+        for res in results
+    }
+    # only the uncommitted file's finding is annotated
+    assert uris == {str(fresh)}, uris
