@@ -1,7 +1,7 @@
 """Headline benchmark: Llama training throughput on the local chip(s).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "platform": ..., "vs_baseline": N}
 
 The reference publishes no LLM-training numbers (BASELINE.md: north-star
 targets "to be established by our harness"), so ``vs_baseline`` is
@@ -132,6 +132,9 @@ def main() -> None:
         "metric": "llama1b_train_tokens_per_sec_per_chip",
         "value": round(tps_chip, 1),
         "unit": "tokens/s/chip",
+        # top-level platform stamp (same contract as bench_core rows):
+        # consumers comparing rows must check it before ratioing
+        "platform": jax.devices()[0].platform,
         "vs_baseline": round(mfu / 0.50, 3),
         "detail": {
             "model_params": llama.param_count(cfg),

@@ -5,7 +5,11 @@ run_microbenchmark.py -> python/ray/_private/ray_perf.py): same metric
 names and shapes as BASELINE.md's table so the ratios are 1:1
 comparable. Prints one JSON line per metric:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "platform": ..., "vs_baseline": N}
+
+Every row is stamped with the detected accelerator platform; baselines
+are cpu-box numbers, so vs_baseline is refused (null) for rows measured
+on any other platform — never compare ratios across hardware.
 
 and a trailing summary line. Baselines were measured on an m4.16xlarge
 (64 vCPU); this harness reports whatever hardware it runs on (the CI
@@ -93,6 +97,28 @@ BASELINES = {
 # "better than baseline" across the table and the geomean
 _LOWER_IS_BETTER = {"submit_path_overhead"}
 
+# every BASELINES number was measured on a CPU-backend box; a row
+# measured on a different accelerator platform is not comparable, so
+# report() stamps the detected platform into each row and refuses the
+# ratio (vs_baseline = None) on a mismatch rather than emitting a
+# cross-platform geomean that looks like a regression/speedup
+BASELINE_PLATFORM = "cpu"
+
+
+def _detect_platform() -> str:
+    """Backend the bench is running against. Only consults jax if the
+    run already imported it (importing jax here would skew rows);
+    otherwise trusts JAX_PLATFORMS, defaulting to cpu."""
+    if "jax" in sys.modules:
+        try:
+            return sys.modules["jax"].default_backend()
+        except Exception:  # noqa: BLE001 — detection must never fail a run
+            pass
+    env = os.environ.get("JAX_PLATFORMS", "").strip()
+    if env:
+        return env.split(",")[0].strip() or "cpu"
+    return "cpu"
+
 SMOKE = False
 QUICK = False
 TRIALS = None  # --trials N: median-of-N, per-trial values in the JSON
@@ -130,8 +156,13 @@ def report(metric: str, value, unit: str) -> None:
     if isinstance(value, list):  # --trials mode: timeit returned samples
         trials_list = [round(v, 3) for v in value]
         value = float(np.median(value))
+    platform = _detect_platform()
     base = BASELINES.get(metric)
-    if base and metric in _LOWER_IS_BETTER:
+    if platform != BASELINE_PLATFORM:
+        # baselines are cpu-box numbers: a tpu/gpu row may not be
+        # ratioed against them (the geomean would mix hardware)
+        ratio = None
+    elif base and metric in _LOWER_IS_BETTER:
         ratio = base / value
     elif base:
         ratio = value / base
@@ -141,6 +172,7 @@ def report(metric: str, value, unit: str) -> None:
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
+        "platform": platform,
         "vs_baseline": round(ratio, 3) if ratio else None,
     }
     if trials_list is not None:
@@ -258,34 +290,37 @@ def main() -> None:
     report("single_client_tasks_bulk", timeit(tasks_bulk), "tasks/s")
 
     def submit_path():
-        # client-side CPU to stage tasks onto the wire: encode args,
-        # draw ids, build the SUBMIT_TASKS payload, pickle the frame —
-        # no sockets, so this isolates the per-call submit overhead the
-        # template/slab work targets from scheduler + worker time
+        # client-side CPU to stage tasks onto the wire, measured as the
+        # PR 18 template-spliced path actually pays it: the frame
+        # PREFIX (fn_id/resources/options) is built once per template —
+        # cached, amortized to ~zero — so each call costs encode_args +
+        # an id draw + one hand-emitted pickle fragment, and each
+        # drained batch one opcode splice. No sockets, so this isolates
+        # per-call submit overhead from scheduler + worker time.
         from ray_tpu._private import protocol as _P
-        from ray_tpu._private.ids import id_slab
-        from ray_tpu._private.serialization import dumps_frame
+        from ray_tpu._private.ids import id_pair
+        from ray_tpu._private.serialization import (
+            close_submit_frame,
+            submit_frame_prefix,
+            task_entry_fragment,
+        )
         from ray_tpu.remote_function import encode_args
 
         n = 64 if SMOKE else 4096
-        encoded = [encode_args(None, (i,), {}) for i in range(n)]
-        slab = id_slab(2 * n)
-        payload = {
+        prefix = submit_frame_prefix(_P.SUBMIT_TASKS, {
             "fn_id": "bench_fn",
             "resources": {"CPU": 1.0},
             "options": {"max_retries": 3},
-            "tasks": [
-                {
-                    "task_id": slab[i],
-                    "args_kind": e[0],
-                    "args_payload": e[1],
-                    "arg_deps": e[2],
-                    "return_ids": [slab[n + i]],
-                }
-                for i, e in enumerate(encoded)
-            ],
-        }
-        dumps_frame((_P.SUBMIT_TASKS, payload))
+            "pipeline": False,
+        })
+        assert prefix is not None
+        frags = []
+        append = frags.append
+        for i in range(n):
+            kind, payload, deps, _holds = encode_args(None, (i,), {})
+            tid, rid = id_pair()
+            append(task_entry_fragment(tid, kind, payload, deps, (rid,)))
+        close_submit_frame(prefix, frags, req_id=1)
         return n
 
     rate = timeit(submit_path)
@@ -513,12 +548,17 @@ def main() -> None:
     if not SMOKE:
         _bench_client_mode()
 
-    ratios = [r["vs_baseline"] for r in RESULTS if r["vs_baseline"]]
+    # geomean only over baseline-platform rows (off-platform rows carry
+    # vs_baseline=None by construction, so the filter is the same — but
+    # say so rather than rely on it silently)
+    ratios = [r["vs_baseline"] for r in RESULTS
+              if r["vs_baseline"] and r.get("platform") == BASELINE_PLATFORM]
     geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
     summary = {
         "metric": "core_microbench_geomean_vs_baseline",
         "value": round(geomean, 3),
         "unit": "ratio",
+        "platform": _detect_platform(),
         "vs_baseline": round(geomean, 3),
         "detail": {r["metric"]: r["value"] for r in RESULTS},
     }
@@ -529,6 +569,7 @@ def main() -> None:
                 {
                     "mode": "smoke" if SMOKE else ("quick" if QUICK else "full"),
                     "trials": TRIALS or 1,
+                    "platform": _detect_platform(),
                     "metrics": {r["metric"]: r for r in RESULTS},
                     "geomean_vs_baseline": round(geomean, 3),
                 },

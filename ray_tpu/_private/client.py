@@ -10,6 +10,25 @@ worker process. It owns:
 
 Both the driver and workers use this same class; workers additionally
 run an executor loop (worker_process.py) fed from `task_queue`.
+
+Submit templates and auto-batching (client hot path, round 3): a plain
+``.remote()`` call no longer builds or pickles a payload dict. The
+RemoteFunction's template caches the invariant frame PREFIX — fn_id,
+canonical resources, job-stamped scheduling options, the pipeline
+flag — as raw pickle opcodes (serialization.submit_frame_prefix), and
+``submit_batched`` splices only the per-call task id, arg blob, and
+deps (serialization.task_entry_fragment) into a pending SUBMIT_TASKS
+frame. Calls to the same template within
+``submit_autobatch_window_us`` coalesce into ONE bulk frame, drained
+by the flusher timer, by capacity (_AB_MAX), or by ANY other outbound
+message — so per-connection FIFO holds against interleaved singles,
+actor calls, and puts. ObjectRefs return synchronously before the
+flush; delivery rides the same _unacked_bulk retransmit + hub
+per-task dedup contract as submit_many. A drain that catches exactly
+one buffered call degrades to the classic SUBMIT_TASK frame (same hub
+handler as window=0, no bulk ack machinery), so sync round trips
+don't pay the batch tax. The window only delays the wire flush, never
+the caller.
 """
 
 from __future__ import annotations
@@ -28,13 +47,15 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .. import exceptions
 from . import protocol as P
 from .debug import log_exc
-from .ids import ActorID, ObjectID, TaskID, id_slab
+from .ids import ActorID, ObjectID, TaskID, id_pair, id_slab
 from .object_store import INLINE_THRESHOLD, ShmObjectStore
 from .serialization import (
+    close_submit_frame,
     dumps_frame,
     dumps_inline,
     loads_frame,
     loads_inline,
+    task_entry_fragment,
 )
 
 
@@ -119,6 +140,25 @@ class CoreClient:
         # so a caller-visible burst (ActorPool.map) leaves as few
         # frames as possible; the byte ceiling still applies.
         self._window_depth = 0
+        # transparent auto-batching (see module docstring): spliced
+        # task fragments pending under _send_lock, keyed by the
+        # template prefix OBJECT (same template+identity reuses the
+        # same cached bytes, so `is` is the batch key) and the trace
+        # context of the calls. Drained by _drain_autobatch_locked.
+        try:
+            window_us = int(_cfg.get("submit_autobatch_window_us", 300))
+        except (TypeError, ValueError):
+            window_us = 300
+        self._ab_window_s = max(0.0, window_us / 1e6)
+        self._ab_prefix: Optional[bytes] = None
+        self._ab_base: Optional[dict] = None
+        self._ab_trace: Optional[tuple] = None
+        self._ab_frags: List[bytes] = []
+        # singleton fast path: the (task_id, kind, payload, deps, rid)
+        # of the FIRST buffered call, kept only while it is alone — a
+        # one-call drain degrades to the classic SUBMIT_TASK frame and
+        # skips the bulk ack machinery (see _drain_autobatch_locked)
+        self._ab_single: Optional[tuple] = None
         # bulk-submit ack tracking: req_id -> [future, payload,
         # next_resend_t, backoff]. SUBMIT_TASKS is fire-and-forget for
         # the caller, so the flusher thread owns the retransmit
@@ -286,6 +326,9 @@ class CoreClient:
 
     def _send_one(self, msg_type: str, payload: dict) -> None:
         with self._send_lock:
+            if self._ab_frags:
+                # FIFO: the pending auto-batch predates this message
+                self._drain_autobatch_locked()
             if self._send_buf:
                 buf, self._send_buf = self._send_buf, []
                 self._buf_cost = 0
@@ -308,6 +351,9 @@ class CoreClient:
                 return
             dup = k == 2
         with self._send_lock:
+            if self._ab_frags:
+                # FIFO: older auto-batched submits leave first
+                self._drain_autobatch_locked()
             was_empty = not self._send_buf
             self._send_buf.append((msg_type, payload))
             if dup:
@@ -334,6 +380,10 @@ class CoreClient:
 
     def flush(self) -> None:
         with self._send_lock:
+            if self._ab_frags:
+                # drain BEFORE the release buffer: an owner-GC release
+                # must never overtake the submit that referenced the id
+                self._drain_autobatch_locked()
             if self._release_buf:
                 # swap-then-drain: concurrent __del__ appends land either
                 # in the drained list (sent now) or the fresh one (next
@@ -373,6 +423,155 @@ class CoreClient:
                 self._window_depth -= 1
             self.flush()
 
+    def submit_batched(self, prefix: bytes, base: dict, args_kind: str,
+                       args_payload: bytes, arg_deps: List[bytes],
+                       trace_ctx: Optional[tuple] = None) -> bytes:
+        """One plain ``.remote()`` call riding the auto-batch window:
+        splice a hand-emitted task fragment under the template's frame
+        prefix and return the return-object id immediately. The frame
+        ships on the next drain — flusher timer (_ab_window_s), the
+        _AB_MAX capacity bound, or any other outbound message (FIFO).
+        A different template or trace context drains the pending batch
+        first, so one frame only ever carries one template's calls."""
+        tid, rid = id_pair()
+        frag = task_entry_fragment(tid, args_kind, args_payload,
+                                   arg_deps, (rid,))
+        if trace_ctx is not None:
+            # outside _send_lock (takes _obj_cache_lock); remembered
+            # against the ambient context — the batch span minted at
+            # drain time is this call's sibling, not known yet
+            self._trace_remember((rid,), trace_ctx)
+        first = False
+        with self._send_lock:
+            if self._ab_frags and (self._ab_prefix is not prefix
+                                   or self._ab_trace != trace_ctx):
+                self._drain_autobatch_locked()
+            self._ab_prefix = prefix
+            self._ab_base = base
+            self._ab_trace = trace_ctx
+            self._ab_frags.append(frag)
+            if len(self._ab_frags) == 1:
+                self._ab_single = (tid, args_kind, args_payload,
+                                   arg_deps, rid)
+            else:
+                self._ab_single = None
+            if len(self._ab_frags) >= self._AB_MAX:
+                self._drain_autobatch_locked()
+            else:
+                first = len(self._ab_frags) == 1
+        if first:
+            # wake the flusher so the window countdown starts now
+            self._buf_evt.set()
+        return rid
+
+    def _drain_autobatch_locked(self) -> None:
+        """Ship the pending auto-batch as ONE SUBMIT_TASKS frame.
+        _send_lock is HELD: no send()/send_async()/flush() calls from
+        here (plain Lock — re-entry deadlocks); span records append
+        straight onto _send_buf. Any already-buffered messages are
+        older than the batch and flush FIRST (per-conn FIFO)."""
+        frags = self._ab_frags
+        if not frags:
+            return
+        # the *_locked contract: every caller already holds _send_lock
+        self._ab_frags = []  # graftlint: disable=GL001
+        prefix = self._ab_prefix
+        base = self._ab_base
+        single = self._ab_single if len(frags) == 1 else None
+        tr = self._ab_trace
+        self._ab_prefix = None  # graftlint: disable=GL001
+        self._ab_base = None  # graftlint: disable=GL001
+        self._ab_single = None  # graftlint: disable=GL001
+        self._ab_trace = None  # graftlint: disable=GL001
+        t0 = time.monotonic()
+        if single is not None and base is not None and tr is None:
+            # a lone call in the window degrades to the CLASSIC
+            # single-task frame: same hub handler and chaos surface as
+            # the window=0 path, no req_id/ack/retransmit bookkeeping —
+            # a sync .remote()+get() round trip must not pay the bulk
+            # ack tax for a batch of one
+            tid, kind, blob, deps, rid = single
+            frame = dumps_frame((P.SUBMIT_TASK, {
+                "task_id": tid,
+                "fn_id": base["fn_id"],
+                "args_kind": kind,
+                "args_payload": blob,
+                "arg_deps": deps,
+                "return_ids": [rid],
+                "resources": base["resources"],
+                "options": base["options"],
+            }))
+            if self._send_buf:
+                buf, self._send_buf = self._send_buf, []
+                self._buf_cost = 0  # graftlint: disable=GL001 — _send_lock held (caller)
+                self.conn.send_bytes(dumps_frame(("batch", buf)))
+            if self._chaos is not None:
+                n = self._chaos.outbound_send(P.SUBMIT_TASK)
+                if n == 0:
+                    return
+                if n == 2:
+                    self.conn.send_bytes(frame)
+            self.conn.send_bytes(frame)
+            return
+        req_id = None
+        fut: Optional[Future] = None
+        if self._RETRY_PERIOD_S > 0:
+            req_id = next(self._req_counter)
+            fut = Future()
+            with self._pending_lock:
+                self._pending[req_id] = fut
+        span_id = self._span_id_hex() if tr is not None else None
+        frame = close_submit_frame(
+            prefix, frags, req_id=req_id,
+            trace=(tr[0], span_id) if tr is not None else None,
+        )
+        if fut is not None:
+            wait_s, nxt = self._retry_delay(self._RETRY_PERIOD_S)
+            while len(self._unacked_bulk) >= 256:
+                # FIFO bound, as in submit_many: eviction only loses
+                # retransmit coverage, the ack still resolves the future
+                self._unacked_bulk.pop(
+                    next(iter(self._unacked_bulk)), None)
+            self._unacked_bulk[req_id] = [
+                fut, frame, time.monotonic() + wait_s, nxt,
+            ]
+        if self._send_buf:
+            buf, self._send_buf = self._send_buf, []
+            self._buf_cost = 0  # graftlint: disable=GL001 — _send_lock held (caller)
+            self.conn.send_bytes(dumps_frame(("batch", buf)))
+        send = True
+        if self._chaos is not None:
+            n = self._chaos.outbound_send(P.SUBMIT_TASKS)
+            if n == 0:
+                send = False  # injected drop: the retransmit entry recovers
+            elif n == 2:
+                self.conn.send_bytes(frame)
+        if send:
+            self.conn.send_bytes(frame)
+        if tr is not None:
+            # ONE client.submit span per drained batch (the submit_many
+            # shape); buffered directly — send_async would re-lock
+            rec = self._span_rec(
+                "client.submit", "submit", tr[0], span_id, tr[1],
+                t0, time.monotonic(), n=len(frags),
+            )
+            self._send_buf.append((P.SPAN_RECORD, rec))  # graftlint: disable=GL001
+
+    def _resend_raw(self, frame: bytes) -> None:
+        """Retransmit a pre-encoded SUBMIT_TASKS frame (flusher
+        thread, _scan_unacked). Replays carry no FIFO obligation — the
+        original send established order — but chaos still sees a
+        logical submit_tasks send."""
+        if self._chaos is not None:
+            n = self._chaos.outbound_send(P.SUBMIT_TASKS)
+            if n == 0:
+                return
+            if n == 2:
+                with self._send_lock:
+                    self.conn.send_bytes(frame)
+        with self._send_lock:
+            self.conn.send_bytes(frame)
+
     def _flush_loop(self) -> None:
         # Catches stray buffered messages right after a burst ends
         # (send latency is event-driven: send_async sets _buf_evt on the
@@ -386,12 +585,17 @@ class CoreClient:
             timeout = 0.05 if self._release_buf else 0.25
             fired = self._buf_evt.wait(timeout=timeout)
             self._buf_evt.clear()
-            if fired and len(self._send_buf) >= 8:
-                # a burst is mid-flight: one scheduler quantum lets the
-                # producer coalesce more before we drain. Below that,
-                # the old unconditional nap only ADDED latency to a
-                # lone urgent message — skip it.
-                time.sleep(0.0005)
+            if fired:
+                if self._ab_frags:
+                    # an auto-batch window is open: let the burst
+                    # accumulate for its full window before draining
+                    time.sleep(self._ab_window_s)
+                elif len(self._send_buf) >= 8:
+                    # a burst is mid-flight: one scheduler quantum lets
+                    # the producer coalesce more before we drain. Below
+                    # that, the old unconditional nap only ADDED latency
+                    # to a lone urgent message — skip it.
+                    time.sleep(0.0005)
             try:
                 self._scan_unacked()
                 self.flush()
@@ -417,7 +621,12 @@ class CoreClient:
             elif now >= entry[2]:
                 wait_s, entry[3] = self._retry_delay(entry[3])
                 entry[2] = now + wait_s
-                self.send_async(P.SUBMIT_TASKS, entry[1])
+                if type(entry[1]) is bytes:
+                    # auto-batched entry: the spliced frame was kept
+                    # verbatim — replay it raw (no re-encode)
+                    self._resend_raw(entry[1])
+                else:
+                    self.send_async(P.SUBMIT_TASKS, entry[1])
         if acked is not None:
             for req_id in acked:
                 self._unacked_bulk.pop(req_id, None)
@@ -639,6 +848,10 @@ class CoreClient:
     _COALESCE_FLOOR = 16
     _COALESCE_CEIL = 512
     _COALESCE_MAX_BYTES = 1 << 20
+    # auto-batch capacity bound: a window's worth of spliced submits
+    # drains early past this many tasks (bounds frame size and the
+    # all-or-nothing retransmit unit)
+    _AB_MAX = 1024
 
     # process-wide client generation counter (see self.client_epoch)
     _EPOCH_COUNTER = itertools.count(1)
@@ -717,18 +930,17 @@ class CoreClient:
             return (_t.new_span_id(), None)
         return None
 
-    def _trace_emit(self, name: str, stage: str, trace_id: str,
-                    span_id: str, parent_id, t0: float, t1: float,
-                    **attrs) -> None:
-        """Ship one finished runtime span to the hub (batched onto the
-        existing connection; never raises into the traced path). The
-        record is built inline against the pre-bound clock anchor — no
-        per-span import, getpid(), or intermediate attrs dict."""
+    def _span_rec(self, name: str, stage: str, trace_id: str,
+                  span_id: str, parent_id, t0: float, t1: float,
+                  **attrs) -> dict:
+        """Build one finished runtime span record against the pre-bound
+        clock anchor — no per-span import, getpid(), or intermediate
+        attrs dict."""
         a = {"stage": stage}
         for k, v in attrs.items():
             a[k] = str(v)
         wall_at = self._wall_at
-        rec = {
+        return {
             "name": name,
             "trace_id": trace_id,
             "span_id": span_id,
@@ -739,6 +951,14 @@ class CoreClient:
             "node_id": self.node_id,
             "attrs": a,
         }
+
+    def _trace_emit(self, name: str, stage: str, trace_id: str,
+                    span_id: str, parent_id, t0: float, t1: float,
+                    **attrs) -> None:
+        """Ship one finished runtime span to the hub (batched onto the
+        existing connection; never raises into the traced path)."""
+        rec = self._span_rec(name, stage, trace_id, span_id, parent_id,
+                             t0, t1, **attrs)
         try:
             self.send_async(P.SPAN_RECORD, rec)
         except Exception:
@@ -1355,25 +1575,40 @@ class CoreClient:
         # a lost push with no re-subscribe is a permanent hang.
         base = self._RETRY_PERIOD_S if self._RETRY_PERIOD_S > 0 else 2.0
         resync = base
+        # index-keyed pending set: ready positions accumulate across
+        # wakes and each wake re-tests ONLY the still-pending ids. The
+        # previous shape rescanned the full ref list on every push wake
+        # — O(n) per wake, O(n^2) across a 1k-ref wait whose
+        # completions stream in one push at a time.
+        pending = dict(enumerate(ids))
+        ready: List[int] = []
+        known = self._known_ready
+        cache = self._obj_cache
+        subscribed = self._ready_subscribed
         while True:
             self._ready_evt.clear()
-            ready = self._scan_ready(ids, num_returns)
-            if len(ready) >= num_returns:
-                return ready
+            with self._obj_cache_lock:
+                hit: List[int] = []
+                for i, b in pending.items():
+                    if b in known or b in cache:
+                        hit.append(i)
+                        if len(ready) + len(hit) >= num_returns:
+                            break
+                for i in hit:
+                    del pending[i]
+                    ready.append(i)
+                if len(ready) >= num_returns:
+                    # positions in ascending order, matching the
+                    # single-scan contract wait_pos callers rely on
+                    ready.sort()
+                    return ready
+                # register any pending id not already covered by a live
+                # subscription (cross-call memo: a pop-loop subscribes
+                # each id ONCE total, not once per dry call); the reply
+                # carries the subset that is already ready hub-side
+                need = [b for b in pending.values() if b not in subscribed]
             if self._closed:
                 raise ConnectionError("hub connection lost")
-            # register any id not already covered by a live
-            # subscription (cross-call memo: a pop-loop subscribes each
-            # id ONCE total, not once per dry call); the reply carries
-            # the subset that is already ready hub-side
-            known = self._known_ready
-            subscribed = self._ready_subscribed
-            with self._obj_cache_lock:
-                need = [
-                    b for b in ids
-                    if b not in known and b not in self._obj_cache
-                    and b not in subscribed
-                ]
             if need:
                 reply = self.request(
                     P.SUBSCRIBE_READY, {"object_ids": need}
@@ -1396,20 +1631,22 @@ class CoreClient:
             if deadline is not None:
                 remaining = min(remaining, deadline - time.monotonic())
                 if remaining <= 0:
+                    ready.sort()
                     return ready
             if not self._ready_evt.wait(remaining):
-                # a full resync period with no push: drop these ids from
-                # the memo so the next pass re-subscribes — the reply
-                # re-syncs readiness even if pushes were lost (chaos) —
-                # and back the period off (no fixed-interval retransmit)
+                # a full resync period with no push: drop the pending
+                # ids from the memo so the next pass re-subscribes —
+                # the reply re-syncs readiness even if pushes were lost
+                # (chaos) — and back the period off (no fixed-interval
+                # retransmit)
                 resync = backed_off
                 with self._obj_cache_lock:
-                    self._ready_subscribed.difference_update(ids)
+                    subscribed.difference_update(pending.values())
             else:
                 # pushes are flowing again: later losses should re-sync
                 # at the base cadence, not the backed-off one
                 resync = base
-                if len(ids) >= 256:
+                if len(pending) >= 256:
                     # push debounce for BIG waits: completions stream
                     # one push at a time, and on a busy single-core
                     # host every wake of this thread steals the GIL
@@ -1417,7 +1654,8 @@ class CoreClient:
                     # process for local drivers). One short sleep
                     # batches the next few pushes into a single
                     # wake/scan instead of one wake per completed task;
-                    # small waits stay latency-exact.
+                    # small waits (and the TAIL of big ones) stay
+                    # latency-exact.
                     time.sleep(0.002)
 
     def free(self, object_ids: Sequence[ObjectID]) -> None:
@@ -1466,15 +1704,21 @@ class CoreClient:
             "quota": None if quota is None else dict(quota),
         })
 
-    def _stamp_job(self, options: dict) -> None:
-        """Attach the job identity to a submit's options (per-call
-        priority=/tenant= overrides win via setdefault). The execution
-        context's identity (set per task/actor call in workers) takes
-        precedence over the client-wide registered one."""
+    def _current_job_identity(self) -> tuple:
+        """(job_id, tenant, priority) in effect for a submit from this
+        thread/context right now — the execution context's identity
+        (set per task/actor call in workers) over the client-wide
+        registered one. Submit templates key their spliced prefix on
+        this tuple so an identity change rebuilds the baked options."""
         ident = _job_identity.get()
         if ident is None:
             ident = (self.job_id, self.tenant, self.priority)
-        job_id, tenant, priority = ident
+        return ident
+
+    def _stamp_job(self, options: dict) -> None:
+        """Attach the job identity to a submit's options (per-call
+        priority=/tenant= overrides win via setdefault)."""
+        job_id, tenant, priority = self._current_job_identity()
         explicit_tenant = options.get("tenant")
         if explicit_tenant and explicit_tenant != tenant:
             # per-call tenant OVERRIDE: this is deliberately not the

@@ -81,6 +81,14 @@ _DEFAULTS: Dict[str, Any] = {
     "request_retry_period_s": 2.0,
     "request_retry_max_s": 30.0,
     "client_batch_max": 128,
+    # transparent auto-batching: plain .remote() calls to the same
+    # template that land within this window (microseconds) ship as ONE
+    # SUBMIT_TASKS frame through the bulk ABI (client.py
+    # submit_batched). ObjectRefs still return synchronously; the
+    # window only delays the WIRE flush. 0 disables — every call rides
+    # the classic per-call SUBMIT_TASK frame; batch_window()/map()
+    # still batch explicitly either way.
+    "submit_autobatch_window_us": 300,
     # memory monitor (reference: common/memory_monitor.h + raylet
     # worker_killing_policy.cc) — kill the newest worker past the cap
     "memory_monitor_period_s": 1.0,

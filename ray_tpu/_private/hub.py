@@ -2832,7 +2832,11 @@ class Hub:
                 # frame's dict across specs would cross-contaminate
                 options=dict(base_opts),
                 retries_left=retries,
-                bulk=True,
+                # bulk pipelining is an opt-IN the explicit bulk paths
+                # (map/submit_many) keep by default; auto-batched plain
+                # .remote() frames splice "pipeline": False so strict
+                # per-call placement semantics survive the batching
+                bulk=p.get("pipeline", True),
             )
             if tr is not None:
                 spec.trace = (tr[0], tr[1])

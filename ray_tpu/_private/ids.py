@@ -68,6 +68,22 @@ def id_slab(n: int) -> list:
     return out
 
 
+def id_pair() -> tuple:
+    """Two pooled ids in one draw — the per-call ``.remote()`` shape
+    (one task id + one return object id). Same entropy pool as
+    ``id_slab``, minus the per-call slab bookkeeping: this sits on the
+    client's batched-submit hot path (bench_core submit_path_overhead)."""
+    buf = getattr(_entropy, "buf", None)
+    pos = getattr(_entropy, "pos", 0)
+    end = pos + 2 * _ID_LEN
+    if buf is None or end > len(buf):
+        buf = _entropy.buf = os.urandom(_ID_LEN * _ID_POOL_IDS)
+        pos, end = 0, 2 * _ID_LEN
+    _entropy.pos = end
+    mid = pos + _ID_LEN
+    return buf[pos:mid], buf[mid:end]
+
+
 def span_id_hex() -> str:
     """16-hex-char tracing span/trace id from the same pooled entropy
     (util/tracing.py): span open is a hot path when runtime sampling is

@@ -27,7 +27,8 @@ one ("batch", [(msg_type, payload), ...]) frame by either side
 HELLO = "hello"
 SUBMIT_TASK = "submit_task"
 SUBMIT_TASKS = "submit_tasks"  # N homogeneous tasks in ONE frame
-                               # (RemoteFunction.map / submit_many):
+                               # (RemoteFunction.map / submit_many /
+                               # the client's transparent auto-batch):
                                # {fn_id, resources, options, tasks:
                                # [{task_id, args_kind, args_payload,
                                # arg_deps, return_ids}, ...], req_id}.
@@ -35,7 +36,17 @@ SUBMIT_TASKS = "submit_tasks"  # N homogeneous tasks in ONE frame
                                # the per-task dicts; the hub acks via
                                # REPLY(req_id) so the client can
                                # retransmit a dropped batch (per-task
-                               # dedup on task_id makes replay safe)
+                               # dedup on task_id makes replay safe).
+                               # Optional "pipeline": False (spliced by
+                               # auto-batched frames) keeps the batch
+                               # out of bulk worker pipelining — plain
+                               # .remote() placement semantics; absent
+                               # = True for the explicit bulk paths.
+                               # Auto-batched frames are SPLICED from a
+                               # cached opcode prefix plus hand-emitted
+                               # per-task fragments (serialization.py)
+                               # — indistinguishable on the wire from a
+                               # dumps_frame encoding of the same dict
 PUT = "put"
 GET = "get"
 WAIT = "wait"

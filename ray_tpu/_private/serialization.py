@@ -43,7 +43,8 @@ cloudpickle-encoded *before* framing.
 from __future__ import annotations
 
 import pickle
-from typing import Any, List, Tuple
+import pickletools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
 
@@ -174,3 +175,176 @@ def loads_frame(blob: bytes) -> Any:
 
 def loads_inline(blob: bytes) -> Any:
     return pickle.loads(blob[1:])
+
+
+# ------------------------------------------------------- frame splicing
+# Template-spliced SUBMIT_TASKS frames (client hot path, round 3). A
+# ``.remote()`` loop re-pickles the same fn_id / resources / options
+# dict on every call; here the invariant *prefix* of the frame is
+# pickled ONCE into raw opcode bytes and each call contributes only a
+# hand-emitted fragment for its task id, arg blob, and deps. The spliced
+# stream decodes with the ordinary ``loads_frame`` — the hub cannot
+# tell a spliced frame from a ``dumps_frame`` one.
+#
+# Splice safety: a fragment cut out of ``pickle.dumps`` output is safe
+# to embed in a foreign stream iff it never READS the memo (GET /
+# BINGET / LONG_BINGET) — MEMOIZE ops only append and are harmless
+# pollution, and mixed framed/unframed opcode runs are legal pickle.
+# ``value_fragment`` verifies that once per template build with
+# ``pickletools.genops``; the per-call emitters below never touch the
+# memo at all. Anything unsafe (shared references inside options, an
+# unpicklable value) returns None and the caller falls back to the
+# plain ``dumps_frame`` path.
+
+_PROTO5 = b"\x80\x05"
+_FRAME_LEAD = 0x95  # FRAME opcode: 8-byte LE length follows
+_MEMO_READS = frozenset(("GET", "BINGET", "LONG_BINGET"))
+
+
+def _op_str(s: str) -> bytes:
+    """SHORT_BINUNICODE / BINUNICODE push of a str."""
+    raw = s.encode("utf-8", "surrogatepass")
+    if len(raw) < 256:
+        return b"\x8c" + bytes((len(raw),)) + raw
+    return b"X" + len(raw).to_bytes(4, "little") + raw
+
+
+def _op_bytes(b: bytes) -> bytes:
+    """SHORT_BINBYTES / BINBYTES push of a bytes value."""
+    if len(b) < 256:
+        return b"C" + bytes((len(b),)) + b
+    return b"B" + len(b).to_bytes(4, "little") + b
+
+
+def _op_int(i: int) -> bytes:
+    """BININT1/2/4 push of an int (LONG1 outside int32)."""
+    if 0 <= i < 256:
+        return b"K" + bytes((i,))
+    if 0 <= i < 65536:
+        return b"M" + i.to_bytes(2, "little")
+    if -0x80000000 <= i <= 0x7FFFFFFF:
+        return b"J" + i.to_bytes(4, "little", signed=True)
+    enc = pickle.encode_long(i)
+    return b"\x8a" + bytes((len(enc),)) + enc
+
+
+def _op_bytes_list(items: Sequence[bytes]) -> bytes:
+    """Push a list of bytes values (EMPTY_LIST or MARK..APPENDS)."""
+    if not items:
+        return b"]"
+    return b"](" + b"".join(_op_bytes(b) for b in items) + b"e"
+
+
+def value_fragment(obj: Any) -> Optional[bytes]:
+    """Pickle ``obj`` into a splice-safe opcode fragment (PROTO/FRAME
+    header and trailing STOP stripped), or None if the result reads the
+    pickle memo and therefore cannot be embedded in a foreign stream."""
+    try:
+        blob = pickle.dumps(obj, protocol=PICKLE5)
+        for op, _arg, _pos in pickletools.genops(blob):
+            if op.name in _MEMO_READS:
+                return None
+    except Exception:
+        return None
+    body = blob[2:] if blob[:2] == _PROTO5 else blob
+    if body and body[0] == _FRAME_LEAD:
+        body = body[9:]
+    if not body.endswith(b"."):
+        return None
+    return body[:-1]
+
+
+def submit_frame_prefix(msg_type: str, fields: Dict[str, Any]) -> Optional[bytes]:
+    """Precompute the invariant prefix of a ``(msg_type, payload)``
+    frame: the payload dict is left OPEN (MARK not yet consumed) so the
+    per-batch close can splice variable items into the same dict. None
+    if any field value is not splice-safe."""
+    parts = [_PROTO5, _op_str(msg_type), b"}("]
+    for k, v in fields.items():
+        frag = value_fragment(v)
+        if frag is None:
+            return None
+        parts.append(_op_str(k))
+        parts.append(frag)
+    return b"".join(parts)
+
+
+# per-call dict keys, emitted once (task_entry_fragment is the per-call
+# hot path; re-encoding constant key strings there is exactly the waste
+# this module exists to remove)
+_K_TASK_ID = _op_str("task_id")
+_K_ARGS_KIND = _op_str("args_kind")
+_K_ARGS_PAYLOAD = _op_str("args_payload")
+_K_ARG_DEPS = _op_str("arg_deps")
+_K_RETURN_IDS = _op_str("return_ids")
+_K_TASKS = _op_str("tasks")
+_K_REQ_ID = _op_str("req_id")
+_K_TRACE = _op_str("trace")
+
+
+# precomputed opcode runs for the dominant task_entry_fragment shape
+# (short ids, inline args, no deps, one return id) — this is THE
+# per-call hot path, so the constant glue between the variable values
+# is emitted once at import instead of five _op_* calls per task
+_LEN1 = tuple(bytes((i,)) for i in range(256))
+_ENTRY_HEAD = b"}(" + _K_TASK_ID + b"C"  # + len1 + task_id
+_KIND_INLINE = _K_ARGS_KIND + _op_str("inline") + _K_ARGS_PAYLOAD
+# empty arg_deps straight into a single short return id: ]e bracket the
+# one-element return_ids list, u closes the task dict
+_TAIL_NODEPS_1RET = _K_ARG_DEPS + b"]" + _K_RETURN_IDS + b"](C"
+
+
+def task_entry_fragment(
+    task_id: bytes,
+    args_kind: str,
+    args_payload: bytes,
+    arg_deps: Sequence[bytes],
+    return_ids: Sequence[bytes],
+) -> bytes:
+    """Hand-emit one SUBMIT_TASKS per-task dict as raw opcodes. Never
+    touches the memo, so it splices into any prefix."""
+    lp = len(args_payload)
+    if (args_kind == "inline" and lp < 256 and not arg_deps
+            and len(return_ids) == 1 and len(task_id) < 256
+            and len(return_ids[0]) < 256):
+        # fast shape: one join over mostly-precomputed runs
+        rid = return_ids[0]
+        return b"".join((
+            _ENTRY_HEAD, _LEN1[len(task_id)], task_id,
+            _KIND_INLINE, b"C", _LEN1[lp], args_payload,
+            _TAIL_NODEPS_1RET, _LEN1[len(rid)], rid, b"eu",
+        ))
+    return b"".join((
+        b"}(",
+        _K_TASK_ID, _op_bytes(task_id),
+        _K_ARGS_KIND, _op_str(args_kind),
+        _K_ARGS_PAYLOAD, _op_bytes(args_payload),
+        _K_ARG_DEPS, _op_bytes_list(arg_deps),
+        _K_RETURN_IDS, _op_bytes_list(return_ids),
+        b"u",
+    ))
+
+
+def close_submit_frame(
+    prefix: bytes,
+    task_frags: Sequence[bytes],
+    req_id: Optional[int] = None,
+    trace: Optional[Tuple[str, str]] = None,
+) -> bytes:
+    """Complete a spliced SUBMIT_TASKS wire frame: prefix + tasks list
+    + optional req_id/trace, closing the payload dict and the
+    (msg_type, payload) tuple. Returns marker-prefixed frame bytes
+    ready for ``Connection.send_bytes``."""
+    parts = [prefix, _K_TASKS, b"]("]
+    parts.extend(task_frags)
+    parts.append(b"e")
+    if req_id is not None:
+        parts.append(_K_REQ_ID)
+        parts.append(_op_int(req_id))
+    if trace is not None:
+        parts.append(_K_TRACE)
+        parts.append(_op_str(trace[0]))
+        parts.append(_op_str(trace[1]))
+        parts.append(b"\x86")
+    parts.append(b"u\x86.")
+    return MARKER_PLAIN + b"".join(parts)

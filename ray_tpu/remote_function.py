@@ -11,9 +11,22 @@ from __future__ import annotations
 import hashlib
 from typing import Any, Dict, List, Optional, Tuple
 
+import pickle
+
 from ._private.object_store import INLINE_THRESHOLD
-from ._private.serialization import dumps_function, dumps_inline
+from ._private.serialization import (
+    MARKER_PLAIN,
+    PICKLE5,
+    dumps_function,
+    dumps_inline,
+)
 from .object_ref import ObjectRef
+
+# encode_args fast path: exact types that can't need spilling (beyond
+# the blob-size check), carry no ObjectRef deps, and pickle identically
+# under stdlib pickle and cloudpickle — no by-reference trap, so the
+# cloudpickle encoder (~5x slower, pure python) can be skipped
+_INLINE_FAST_TYPES = frozenset((int, float, bool, str, bytes, type(None)))
 
 # Options accepted by @remote / .options() — superset kept aligned with
 # the reference's ray_option_utils.py validation table.
@@ -67,6 +80,19 @@ def encode_args(client, args: tuple, kwargs: dict):
     refs so spilled args are freed when the call's results are dropped
     (the hub pins them while the task is in flight), instead of leaking
     one shm segment per call."""
+    if not kwargs:
+        # all-primitive positional call (the .remote() hot-path shape):
+        # nothing can be an ObjectRef or ndarray, so skip the spill
+        # scan, and stdlib pickle's C encoder replaces cloudpickle.
+        # Plain loop, not all(genexpr) — this runs per .remote() call.
+        for a in args:
+            if type(a) not in _INLINE_FAST_TYPES:
+                break
+        else:
+            blob = MARKER_PLAIN + pickle.dumps((args, kwargs), PICKLE5)
+            if len(blob) <= INLINE_THRESHOLD:
+                return "inline", blob, [], []
+            # an oversized str/bytes arg still spills — fall through
     import numpy as np
 
     deps: List[bytes] = []
@@ -347,9 +373,19 @@ class _SubmitTemplate:
     runtime_env packaging, which may upload wheels/zips), and the
     max_retries default. Per call only the args/ids re-encode; callers
     shallow-copy ``options`` before submitting because the client's
-    job stamp (setdefault) and the hub mutate options in place."""
+    job stamp (setdefault) and the hub mutate options in place.
 
-    __slots__ = ("fn_id", "num_returns", "resources", "options")
+    ``splice`` extends the template to raw bytes: (job-identity tuple,
+    frame prefix) — the invariant fields of a SUBMIT_TASKS frame
+    pickled ONCE (serialization.submit_frame_prefix) with the job
+    stamp baked in, so a plain ``.remote()`` call splices only its
+    per-call fragment (client.submit_batched). Rebuilt when the
+    identity changes; ``splice_broken`` latches a template whose
+    options defeat splicing (memo-reading pickle) onto the classic
+    per-call path permanently."""
+
+    __slots__ = ("fn_id", "num_returns", "resources", "options",
+                 "splice", "splice_broken")
 
 
 class RemoteFunction:
@@ -365,6 +401,10 @@ class RemoteFunction:
         self._export_epoch = 0
         self._tpl: Optional[_SubmitTemplate] = None
         self._tpl_epoch = 0
+        # .options() variants keep the classic unbatched frame: the
+        # override is the caller saying "this call is different" —
+        # auto-batching stays reserved for the plain decorated function
+        self._variant = False
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
 
@@ -392,9 +432,48 @@ class RemoteFunction:
         process_runtime_env(client, opts, options)
         options.setdefault("max_retries", opts.get("max_retries", 3))
         tpl.options = options
+        tpl.splice = None
+        tpl.splice_broken = False
         self._tpl = tpl
         self._tpl_epoch = client.client_epoch
         return tpl
+
+    def _splice_prefix(self, client, tpl: _SubmitTemplate):
+        """The template's (frame prefix, classic-payload base) for the
+        CURRENT job identity (cached on the template; one slot —
+        identity changes mid-process are worker-side rarities, not a
+        hot path). The base dict carries the same stamped invariant
+        fields as the prefix so a singleton drain can fall back to the
+        classic SUBMIT_TASK frame without re-stamping. None = this
+        template cannot splice; the caller falls back to the classic
+        frame and splice_broken stops re-trying."""
+        ident = client._current_job_identity()
+        cached = tpl.splice
+        if cached is not None and cached[0] == ident:
+            return cached[1], cached[2]
+        from ._private import protocol as P
+        from ._private.serialization import submit_frame_prefix
+
+        stamped = dict(tpl.options)
+        client._stamp_job(stamped)
+        prefix = submit_frame_prefix(P.SUBMIT_TASKS, {
+            "fn_id": tpl.fn_id,
+            "resources": tpl.resources,
+            "options": stamped,
+            # strict .remote() placement semantics: auto-batched tasks
+            # must not opt into bulk pipelining (hub _pipeline_ok)
+            "pipeline": False,
+        })
+        if prefix is None:
+            tpl.splice_broken = True
+            return None
+        base = {
+            "fn_id": tpl.fn_id,
+            "resources": tpl.resources,
+            "options": stamped,
+        }
+        tpl.splice = (ident, prefix, base)
+        return prefix, base
 
     def options(self, **opts) -> "RemoteFunction":
         merged = dict(self._options)
@@ -402,6 +481,7 @@ class RemoteFunction:
         rf = RemoteFunction(self._fn, merged)
         rf._fn_blob = self._fn_blob
         rf._fn_id = self._fn_id
+        rf._variant = True
         return rf
 
     def remote(self, *args, **kwargs):
@@ -447,6 +527,31 @@ class RemoteFunction:
         tpl = self._template(client)
         args_kind, args_payload, deps, holds = encode_args(
             client, args, kwargs)
+        # transparent auto-batching: a plain single-return call with a
+        # spliceable template rides the bulk ABI through the client's
+        # window. num_returns/options() overrides, window=0, broken
+        # splices, and per-call head-sampled tracing (no ambient
+        # context to key the batch on) all keep the classic frame.
+        if (tpl.num_returns == 1 and not self._variant
+                and not tpl.splice_broken and client._ab_window_s > 0.0):
+            trace_ctx = None
+            batchable = True
+            if client._tracing_live():
+                trace_ctx = client._trace_ctx()
+                if trace_ctx is None:
+                    batchable = False
+            if batchable:
+                spl = self._splice_prefix(client, tpl)
+                if spl is not None:
+                    from ._private.ids import ObjectID
+
+                    rid = client.submit_batched(
+                        spl[0], spl[1], args_kind, args_payload, deps,
+                        trace_ctx)
+                    ref = ObjectRef(ObjectID(rid), _owned=True)
+                    if holds:
+                        ref._hold = holds
+                    return ref
         return_ids = client.submit_task(
             tpl.fn_id, args_kind, args_payload, deps, tpl.num_returns,
             tpl.resources, dict(tpl.options),

@@ -20,4 +20,5 @@ from . import (  # noqa: F401
     gl015_async_discipline,
     gl016_resource_lifecycle,
     gl017_deadline_conformance,
+    gl018_invariant_reserialization,
 )

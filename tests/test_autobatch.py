@@ -1,0 +1,340 @@
+"""Transparent auto-batching of plain ``.remote()`` calls (client hot
+path, round 3): template-spliced SUBMIT_TASKS frames.
+
+Tier-1 coverage for the spliced wire path:
+  - splice decode equality: a frame assembled from a cached opcode
+    prefix plus hand-emitted per-task fragments decodes exactly like a
+    ``dumps_frame`` encoding of the same payload dict;
+  - memo-safety: values whose pickle reads the memo (shared refs) are
+    rejected at template-build time, falling back to the classic path;
+  - burst semantics: a loop of plain ``.remote()`` calls rides the
+    batched path, including kwargs and ObjectRef args (arg_deps);
+  - fallbacks: num_returns > 1 and ``.options()`` variants stay on the
+    classic per-call path — and don't poison the base function;
+  - window=0: auto-batching disabled reverts to the per-call
+    SUBMIT_TASK frames byte-for-byte (the untouched PR 12 path);
+  - singleton degrade: a drain catching exactly one call ships the
+    classic SUBMIT_TASK frame (no bulk ack machinery), so sync round
+    trips don't pay the batch tax;
+  - FIFO: pending auto-batches drain before ANY other outbound message,
+    so admission order matches submission order across batch, explicit
+    bulk, put, and actor-call boundaries;
+  - chaos: dropped and duplicated auto-batch frames recover through the
+    REPLY(req_id) ack + raw-bytes retransmit + per-task dedup.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+# --------------------------------------------------------------- splicing
+
+
+def test_spliced_frame_decodes_like_dumps_frame():
+    from ray_tpu._private import protocol as P
+    from ray_tpu._private.ids import id_slab
+    from ray_tpu._private.serialization import (
+        close_submit_frame,
+        dumps_frame,
+        loads_frame,
+        submit_frame_prefix,
+        task_entry_fragment,
+    )
+
+    fields = {
+        "fn_id": "f" * 40,
+        "resources": {"CPU": 1.0, "custom": 2.5},
+        "options": {"max_retries": 3, "name": "t", "priority": 7},
+        "pipeline": False,
+    }
+    prefix = submit_frame_prefix(P.SUBMIT_TASKS, fields)
+    assert prefix is not None
+
+    slab = id_slab(8)
+    tasks, frags = [], []
+    # payload shapes: short (fast fragment path), >255 B (BINBYTES),
+    # empty; middle task also carries an arg dep and two return ids
+    for i, pay in enumerate((b"p", b"q" * 300, b"")):
+        tid, rid = slab[2 * i], slab[2 * i + 1]
+        deps = [slab[6]] if i == 1 else []
+        rids = [rid, slab[7]] if i == 1 else [rid]
+        frags.append(
+            task_entry_fragment(tid, "inline", pay, deps, rids)
+        )
+        tasks.append({
+            "task_id": tid, "args_kind": "inline", "args_payload": pay,
+            "arg_deps": deps, "return_ids": rids,
+        })
+
+    frame = close_submit_frame(
+        prefix, frags, req_id=42, trace=("t" * 16, "s" * 16)
+    )
+    want = dict(fields)
+    want["tasks"] = tasks
+    want["req_id"] = 42
+    want["trace"] = ("t" * 16, "s" * 16)
+    assert loads_frame(frame) == (P.SUBMIT_TASKS, want)
+    # ...and both decode identically to the ordinary encoder's output
+    assert loads_frame(dumps_frame((P.SUBMIT_TASKS, want))) == (
+        P.SUBMIT_TASKS, want
+    )
+
+
+def test_memo_reading_values_are_rejected():
+    """A value whose pickle READS the memo (shared reference) cannot be
+    spliced into a foreign stream; the template build must refuse it so
+    the caller falls back to dumps_frame."""
+    from ray_tpu._private.serialization import (
+        submit_frame_prefix,
+        value_fragment,
+    )
+
+    shared = {"a": 1}
+    assert value_fragment({"x": shared, "y": shared}) is None
+    assert value_fragment({"plain": 1, "ok": "yes"}) is not None
+    assert submit_frame_prefix(
+        "submit_tasks", {"options": {"x": shared, "y": shared}}
+    ) is None
+
+
+# ------------------------------------------------------------ burst paths
+
+
+def test_plain_remote_rides_autobatch(ray_start_4_cpus, monkeypatch):
+    from ray_tpu._private.client import CoreClient
+
+    batched, singles = [], []
+    orig_b = CoreClient.submit_batched
+    orig_s = CoreClient.submit_task
+
+    def spy_b(self, *a, **k):
+        batched.append(1)
+        return orig_b(self, *a, **k)
+
+    def spy_s(self, *a, **k):
+        singles.append(1)
+        return orig_s(self, *a, **k)
+
+    monkeypatch.setattr(CoreClient, "submit_batched", spy_b)
+    monkeypatch.setattr(CoreClient, "submit_task", spy_s)
+
+    @ray_tpu.remote
+    def add(a, b=0):
+        return a + b
+
+    refs = [add.remote(i) for i in range(100)]
+    refs.append(add.remote(1, b=2))  # kwargs ride the batch too
+    assert ray_tpu.get(refs, timeout=60) == [*range(100), 3]
+    assert len(batched) == 101
+    assert not singles
+
+
+def test_ref_args_through_autobatch(ray_start_4_cpus):
+    """ObjectRef args populate arg_deps — the non-fast fragment shape —
+    and the hub must still gate execution on the dep."""
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    x = ray_tpu.put(10)
+    refs = [add.remote(x, i) for i in range(20)]
+    assert ray_tpu.get(refs, timeout=60) == [10 + i for i in range(20)]
+
+
+def test_lone_call_degrades_to_classic_frame(ray_start_4_cpus):
+    """A drain that catches exactly ONE buffered call ships the classic
+    SUBMIT_TASK frame — same hub handler as the window=0 path, no bulk
+    req_id/ack machinery — so a sync .remote()+get() round trip never
+    pays the batch ack tax for a batch of one."""
+    from ray_tpu._private import protocol as P
+    from ray_tpu._private import worker
+    from ray_tpu._private.serialization import loads_frame
+
+    @ray_tpu.remote
+    def echo(x):
+        return x
+
+    assert ray_tpu.get(echo.remote(0)) == 0  # export the function first
+    client = worker.get_client()
+    assert client._ab_window_s > 0
+    frames = []
+    orig = client.conn.send_bytes
+
+    def spy(blob):
+        frames.append(blob)
+        return orig(blob)
+
+    client.conn.send_bytes = spy
+    try:
+        assert ray_tpu.get(echo.remote(3)) == 3
+    finally:
+        client.conn.send_bytes = orig
+    kinds = [loads_frame(b)[0] for b in frames]
+    assert P.SUBMIT_TASK in kinds, kinds
+    assert P.SUBMIT_TASKS not in kinds, kinds
+
+
+def test_variant_and_multi_return_fall_back(ray_start_4_cpus, monkeypatch):
+    from ray_tpu._private.client import CoreClient
+
+    batched = []
+    orig = CoreClient.submit_batched
+
+    def spy(self, *a, **k):
+        batched.append(1)
+        return orig(self, *a, **k)
+
+    monkeypatch.setattr(CoreClient, "submit_batched", spy)
+
+    @ray_tpu.remote(num_returns=2)
+    def split(i):
+        return i, -i
+
+    a, b = split.remote(5)
+    assert ray_tpu.get([a, b], timeout=60) == [5, -5]
+
+    @ray_tpu.remote
+    def f(i):
+        return i + 1
+
+    assert ray_tpu.get(f.options(name="v").remote(1), timeout=60) == 2
+    assert not batched, "num_returns/options() must stay unbatched"
+
+    # the .options() clone is the variant, not the base function: plain
+    # calls afterwards still batch
+    assert ray_tpu.get([f.remote(i) for i in range(5)], timeout=60) == [
+        1, 2, 3, 4, 5,
+    ]
+    assert batched
+
+
+@pytest.fixture
+def autobatch_off(monkeypatch):
+    # env, not RAY_TPU_CONFIG.set(): the hub runs config.reload() at
+    # construction, which rebuilds the table from env and would wipe a
+    # .set() override before the driver client reads it
+    monkeypatch.setenv("RAY_TPU_SUBMIT_AUTOBATCH_WINDOW_US", "0")
+    try:
+        ctx = ray_tpu.init(num_cpus=2, max_workers=2)
+        yield ctx
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_window_zero_reverts_to_classic_path(autobatch_off, monkeypatch):
+    """submit_autobatch_window_us=0 disables the spliced path entirely:
+    every call takes the untouched per-call SUBMIT_TASK code path (the
+    frames are byte-identical to the pre-autobatch client's)."""
+    from ray_tpu._private import worker
+    from ray_tpu._private.client import CoreClient
+
+    client = worker.get_client()
+    assert client._ab_window_s == 0.0
+
+    def boom(self, *a, **k):
+        raise AssertionError("submit_batched must not run with window=0")
+
+    monkeypatch.setattr(CoreClient, "submit_batched", boom)
+
+    sent = []
+    orig = client.submit_task
+
+    def spy(fn_id, *a, **k):
+        sent.append(fn_id)
+        return orig(fn_id, *a, **k)
+
+    monkeypatch.setattr(client, "submit_task", spy)
+
+    @ray_tpu.remote
+    def f(i):
+        return i * 3
+
+    assert ray_tpu.get(
+        [f.remote(i) for i in range(20)], timeout=60
+    ) == [i * 3 for i in range(20)]
+    assert len(sent) == 20
+
+
+# ------------------------------------------------------------------ FIFO
+
+
+def test_autobatch_fifo_across_drains(ray_start_4_cpus):
+    """Admission order must match submission order even when an
+    auto-batch is pending: every other outbound message (explicit bulk,
+    put, actor call) drains the batch FIRST. Each stamp task claims the
+    whole node, so execution is strictly serial and completion
+    timestamps reveal admission order."""
+    @ray_tpu.remote(num_cpus=4)
+    def stamp(_tag):
+        return time.monotonic()
+
+    @ray_tpu.remote
+    class Tag:
+        def tag(self, v):
+            return v
+
+    head = stamp.remote("head")                       # pending batch
+    mid = stamp.map([(f"m{i}",) for i in range(3)])   # bulk: drains head
+    burst = [stamp.remote(f"b{i}") for i in range(6)]  # new pending batch
+    x = ray_tpu.put(b"x")                             # put: drains burst
+    actor = Tag.remote()
+    t = actor.tag.remote("actor")                     # rides post-drain
+    tail = stamp.remote("tail")
+
+    times = ray_tpu.get([head, *mid, *burst, tail], timeout=90)
+    assert times == sorted(times), "auto-batch broke per-conn FIFO order"
+    assert ray_tpu.get(x) == b"x"
+    assert ray_tpu.get(t, timeout=60) == "actor"
+
+
+# ----------------------------------------------------------------- chaos
+
+
+@pytest.fixture
+def chaos_autobatch(monkeypatch):
+    """Runtime factory: chaos plan set BEFORE init (the hub reads the
+    env at construction); fast retransmit keeps drop tests quick."""
+    from ray_tpu._private.client import CoreClient
+
+    monkeypatch.setattr(CoreClient, "_RETRY_PERIOD_S", 0.2)
+
+    def start(plan):
+        monkeypatch.setenv("RAY_TPU_CHAOS_PLAN", plan)
+        return ray_tpu.init(num_cpus=2, max_workers=2)
+
+    yield start
+    ray_tpu.shutdown()
+
+
+def test_autobatch_survives_hub_drop_and_dup(chaos_autobatch):
+    """Hub-scope chaos: half the auto-batched SUBMIT_TASKS frames are
+    dropped on arrival (no REPLY -> raw-bytes retransmit) and half are
+    delivered twice (per-task dedup on the hub). Every call must still
+    produce its result exactly once."""
+    chaos_autobatch("seed=13;drop:submit_tasks@0.5;dup:submit_tasks@0.5")
+
+    @ray_tpu.remote
+    def f(i):
+        return i + 1
+
+    refs = [f.remote(i) for i in range(60)]
+    assert ray_tpu.get(refs, timeout=120) == [i + 1 for i in range(60)]
+
+
+def test_autobatch_survives_client_outbound_chaos(chaos_autobatch):
+    """Client-scope chaos: the drain's own outbound_send hook drops or
+    duplicates the frame before it ever hits the socket — recovery is
+    the same ack/retransmit/dedup triangle."""
+    chaos_autobatch(
+        "seed=7;drop:client.submit_tasks@0.5;dup:client.submit_tasks@0.5"
+    )
+
+    @ray_tpu.remote
+    def g(i):
+        return i * 2
+
+    refs = [g.remote(i) for i in range(60)]
+    assert ray_tpu.get(refs, timeout=120) == [i * 2 for i in range(60)]

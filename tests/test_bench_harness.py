@@ -51,6 +51,19 @@ def test_smoke_run_reports_every_baseline_metric(tmp_path):
 
     missing = set(BASELINES) - set(data["metrics"])
     assert not missing, f"BASELINES metrics missing from report: {missing}"
+    # platform stamping (PR 18): the run-level platform plus one stamp
+    # per row; on the baseline platform vs_baseline must be computed
+    # for every BASELINES row, and report() refuses the ratio anywhere
+    # else — a cross-platform geomean must be impossible to emit
+    from bench_core import BASELINE_PLATFORM
+
+    assert data["platform"] == BASELINE_PLATFORM  # JAX_PLATFORMS=cpu above
+    for name, rec in data["metrics"].items():
+        assert rec.get("platform"), f"{name} row missing platform stamp"
+        if rec["platform"] != BASELINE_PLATFORM:
+            assert rec["vs_baseline"] is None, name
+        elif name in BASELINES:
+            assert rec["vs_baseline"] is not None, name
     # tracing_overhead schema: the on/off throughput ratio with runtime
     # tracing head-sampled at 1.0 (evidence row, never gated)
     overhead = data["metrics"]["tracing_overhead"]
@@ -64,3 +77,29 @@ def test_smoke_run_reports_every_baseline_metric(tmp_path):
         if line.startswith("{")
     ]
     assert {p["metric"] for p in parsed} >= set(BASELINES)
+
+
+def test_report_refuses_cross_platform_ratio(monkeypatch):
+    """A row measured on non-baseline hardware gets its platform
+    stamped and its vs_baseline refused (None) — cpu-box baselines are
+    not comparable to tpu/gpu numbers."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench_core
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    monkeypatch.setattr(bench_core, "RESULTS", [])
+    monkeypatch.setattr(bench_core, "_detect_platform", lambda: "tpu")
+    bench_core.report("single_client_tasks_async", 9999.0, "tasks/s")
+    rec = bench_core.RESULTS[-1]
+    assert rec["platform"] == "tpu"
+    assert rec["vs_baseline"] is None
+
+    monkeypatch.setattr(
+        bench_core, "_detect_platform", lambda: bench_core.BASELINE_PLATFORM
+    )
+    bench_core.report("single_client_tasks_async", 9999.0, "tasks/s")
+    rec = bench_core.RESULTS[-1]
+    assert rec["platform"] == bench_core.BASELINE_PLATFORM
+    assert rec["vs_baseline"] is not None

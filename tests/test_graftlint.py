@@ -1365,6 +1365,136 @@ def test_reverting_client_fixed_retransmit_is_flagged():
     assert "GL011" in {f.code for f in fresh}, [f.render() for f in fresh]
 
 
+# --------------------------------------------------------------------- GL018
+
+
+_GL018_SUBMIT_LOOP = """
+    import pickle
+
+    def submit_all(self, fn_id, resources, options, tasks):
+        for t in tasks:
+            head = pickle.dumps(
+                {"fn_id": fn_id, "resources": resources,
+                 "options": options}
+            )
+            self.conn.send_bytes(head + t)
+"""
+
+
+def test_gl018_flags_invariant_header_reencoded_per_send():
+    # the pre-splice submit shape: the (fn_id, resources, options)
+    # header pickled once PER TASK inside the send loop
+    assert "GL018" in codes_of(_GL018_SUBMIT_LOOP, path=_PRIV)
+
+
+def test_gl018_flags_while_loop_retransmit_reencode():
+    # same bug in its retransmit spelling: the frame re-encoded on
+    # every resend instead of cached once (_resend_raw ships bytes)
+    src = """
+    def retransmit(self, msg_type, payload, fut):
+        while not fut.done():
+            self.evt.wait(0.2)
+            self.conn.send_bytes(dumps_frame((msg_type, payload)))
+    """
+    assert "GL018" in codes_of(src, path=_PRIV)
+
+
+def test_gl018_clean_when_encode_hoisted():
+    # the fix shape: one encode above the loop
+    src = """
+    import pickle
+
+    def submit_all(self, fn_id, resources, options, tasks):
+        head = pickle.dumps(
+            {"fn_id": fn_id, "resources": resources, "options": options}
+        )
+        for t in tasks:
+            self.conn.send_bytes(head + t)
+    """
+    assert "GL018" not in codes_of(src, path=_PRIV)
+
+
+def test_gl018_clean_when_payload_varies_per_iteration():
+    # the encoded dict reads the loop variable: a genuinely per-call
+    # payload, not a hoistable invariant
+    src = """
+    import pickle
+
+    def submit_all(self, fn_id, tasks):
+        for t in tasks:
+            self.conn.send_bytes(
+                pickle.dumps({"fn_id": fn_id, "task": t})
+            )
+    """
+    assert "GL018" not in codes_of(src, path=_PRIV)
+
+
+def test_gl018_clean_on_dynamic_expression():
+    # a nested call can yield a fresh value per iteration even from
+    # invariant inputs — the checker must not guess
+    src = """
+    def submit_all(self, options, tasks):
+        for t in tasks:
+            self.conn.send_bytes(dumps(self._header(options)))
+    """
+    assert "GL018" not in codes_of(src, path=_PRIV)
+
+
+def test_gl018_clean_when_loop_rebinds_the_attribute():
+    src = """
+    def pump(self):
+        while self.live:
+            self.frame = self.advance()
+            self.conn.send_bytes(dumps(self.frame))
+    """
+    assert "GL018" not in codes_of(src, path=_PRIV)
+
+
+def test_gl018_clean_without_a_send_in_the_loop():
+    # encode-only loops (codecs, tests building corpora) are not the
+    # hot path this rule protects
+    src = """
+    import pickle
+
+    def encode_all(self, header, tasks):
+        out = []
+        for _t in tasks:
+            out.append(pickle.dumps(header))
+        return out
+    """
+    assert "GL018" not in codes_of(src, path=_PRIV)
+
+
+def test_gl018_scope_is_runtime_core():
+    # remote_function.py owns the .remote() staging path and is gated
+    # alongside _private/; library/util code stays out of scope
+    assert "GL018" in codes_of(
+        _GL018_SUBMIT_LOOP, path="ray_tpu/remote_function.py"
+    )
+    assert "GL018" not in codes_of(
+        _GL018_SUBMIT_LOOP, path="ray_tpu/util/x.py"
+    )
+
+
+def test_reverting_per_fragment_reencode_is_flagged():
+    """The bug GL018 was written against: before the spliced-template
+    path, the submit pipeline re-encoded the invariant batch header
+    once per task. Re-applying a per-fragment re-encode + send loop to
+    the REAL _drain_autobatch_locked must trip GL018 against the live
+    tree."""
+    fresh = live_revert(
+        "_private/client.py",
+        "        if send:\n"
+        "            self.conn.send_bytes(frame)",
+        "        if send:\n"
+        "            for _frag in frags:\n"
+        "                head = dumps_frame((P.SUBMIT_TASKS, prefix))\n"
+        "                self.conn.send_bytes(head)",
+        codes={"GL018"},
+    )
+    assert "GL018" in {f.code for f in fresh}, [f.render() for f in fresh]
+
+
 # ------------------------------------------------------------- repo gate
 
 
@@ -1388,7 +1518,7 @@ def test_every_checker_is_exercised_by_the_gate_config():
     codes = {code for code, _name, _fn in all_checkers()}
     assert codes == {
         "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007",
-        "GL008", "GL009", "GL010", "GL011",
+        "GL008", "GL009", "GL010", "GL011", "GL018",
     }
     # the whole-program passes run through the same gate (check_paths
     # builds one ProjectSession over the package and runs them after

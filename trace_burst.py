@@ -1,0 +1,74 @@
+"""Trace harness: analyze_trace of a 1k-call plain ``.remote()`` burst.
+
+Companion to bench_core.py's throughput rows — this answers *where the
+time goes* for a naive submit loop, per the trace-first rule in
+ROADMAP.md. Runs with runtime tracing forced on, wraps the burst in one
+user span so every call stitches into a single trace, then feeds the
+collected spans through util.tracing.analyze_trace and prints the
+stage breakdown as JSON. The before/after artifacts live in
+TRACE_pr18.md.
+
+Usage:  JAX_PLATFORMS=cpu python trace_burst.py [n_calls]
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["RAY_TPU_TRACING"] = "1"
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+    ray_tpu.init(num_cpus=2, max_workers=2)
+    try:
+        from ray_tpu._private import worker
+
+        hub = worker._hub
+        if hub is not None:
+            # the default 1024-span-per-trace cap would truncate a
+            # 1k-call burst's ~4k spans and bias the stage shares
+            # toward whatever finishes first
+            hub._trace_span_max = 65536
+        client = worker.get_client()
+
+        @ray_tpu.remote
+        def noop(i):
+            return i
+
+        # warmup outside the trace: worker spawn + function registration
+        # are one-time costs, not part of the steady-state submit path
+        ray_tpu.get([noop.remote(i) for i in range(20)], timeout=60)
+
+        with tracing.span("burst"):
+            ctx = tracing.current_context()
+            refs = [noop.remote(i) for i in range(n)]
+            ray_tpu.get(refs, timeout=180)
+        trace_id = ctx[0]
+
+        # spans land at the hub asynchronously; poll until the count
+        # stops growing
+        prev = -1
+        spans = []
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            spans = client.list_state("traces", trace_id=trace_id)
+            if spans and len(spans) == prev:
+                break
+            prev = len(spans)
+            time.sleep(0.5)
+
+        analysis = tracing.analyze_trace(spans)
+        json.dump(analysis, sys.stdout, indent=2)
+        print()
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
