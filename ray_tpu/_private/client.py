@@ -270,6 +270,7 @@ class CoreClient:
             P.PUBSUB_MSG: self._on_pubsub_msg,
             P.CANCEL_TASK: self._on_cancel_task,
             P.READY_PUSH: self._on_ready_push,
+            P.STACK_DUMP: self._on_stack_dump,
         }
         self.send(P.HELLO, {"role": role, "worker_id": worker_id,
                             "pid": os.getpid(), "node_id": self.node_id})
@@ -288,6 +289,16 @@ class CoreClient:
 
         self._flusher = threading.Thread(target=self._flush_loop, daemon=True, name="core-client-flusher")
         self._flusher.start()
+
+        # sampling profiler (profiling.py): with RAY_TPU_PROFILE_HZ at
+        # its default 0 this creates NOTHING — no thread, no wire
+        # frames (the tier-1 zero-cost guard asserts it). Batches ride
+        # the buffered async channel like metric records. In the local
+        # driver the hub thread may already own the process sampler;
+        # first caller wins either way.
+        from . import profiling as _profiling
+
+        _profiling.maybe_start(role, self._profile_sink)
 
     def start_prewarm(self, store_cap: float = 0.0) -> None:
         """Kick the background warm-pool prewarm (driver only; see
@@ -796,6 +807,41 @@ class CoreClient:
             cb(data)
         except Exception:
             pass
+
+    def _profile_sink(self, batch: dict) -> None:
+        """Sampler flush target (profiling.Sampler, its own daemon
+        thread): folded stacks ride the async buffer to the hub. Never
+        raises — a half-closed connection must not kill the sampler."""
+        if self._closed:
+            return
+        try:
+            self.send_async(P.PROFILE_BATCH, batch)
+        except Exception:
+            pass
+
+    def _on_stack_dump(self, payload):
+        """Reader-thread handler for a brokered `ray_tpu stack` dump.
+        Deliberately NOT routed through the task queue: the executor
+        being wedged is exactly when a dump is wanted."""
+        from . import profiling as _profiling
+
+        try:
+            self.send(P.STACK_REPLY, {
+                "token": payload.get("token"),
+                "pid": os.getpid(),
+                "threads": _profiling.dump_threads(),
+            })
+        except Exception:
+            pass
+
+    def stack_dump(self, target: str = "hub", timeout: float = 10.0) -> dict:
+        """All-thread stack dump of one runtime process (`ray_tpu
+        stack`): target is "hub", a worker id, or a worker pid. The hub
+        answers for itself inline and brokers worker targets over their
+        control connection (STACK_DUMP/STACK_REPLY)."""
+        return self.request(
+            P.STACK_REQUEST, {"target": str(target)}, timeout=timeout
+        )
 
     def _on_cancel_task(self, payload):
         # reader-thread fast path: mark before the executor

@@ -97,6 +97,19 @@ _DEFAULTS: Dict[str, Any] = {
     "task_events_max": 20000,
     "runtime_events_max": 2000,          # flight-recorder ring size
     "builtin_metrics": True,             # ray_tpu_* runtime self-metrics
+    # sampling profiler (profiling.py): wall-clock sample rate in Hz for
+    # the per-process daemon sampler. 0 (the default) = the sampler
+    # thread is never created and no PROFILE_BATCH frames exist on the
+    # wire — the only residue is one env read at process start. Workers
+    # and clients read the RAY_TPU_PROFILE_HZ env directly (like
+    # chaos_plan: they never run reload()).
+    "profile_hz": 0.0,
+    "profile_overhead_budget": 0.03,     # self-overhead ratio past which
+                                         # the sampler halves its rate
+                                         # (auto-clamp; 0 = never clamp)
+    "profile_flush_period_s": 1.0,       # local fold -> hub batch cadence
+    "profile_store_max": 4096,           # hub cap on distinct folded
+                                         # stacks kept per process
     "node_heartbeat_period_s": 2.0,      # per-node gauge cadence; 0 = off
     "flight_recorder_path": "",          # "" = <session_dir>/flight_recorder.json
     # fault tolerance (reference: num_heartbeats_timeout in

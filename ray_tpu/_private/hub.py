@@ -99,6 +99,13 @@ class ObjEntry:
     # bookkeeping: the hub sees every submit, so it counts directly.
     pins: int = 0
     release_pending: bool = False  # owner released while pinned
+    # leak attribution (`ray_tpu memory`): the process holding the
+    # ObjectRef — the submitter for task returns, the putter for puts
+    # ("driver" / "client-N" / a worker id; "" = placeholder entry).
+    # created_t is the entry's birth (monotonic), so age is a duration
+    # per GL008; display code converts to seconds-old at list time.
+    owner: str = ""
+    created_t: float = field(default_factory=time.monotonic)
 
 
 @dataclass
@@ -166,6 +173,9 @@ class TaskSpec:
     # submit was head-sampled (util/tracing.py). None = untraced — every
     # span-emission site gates on it, so the default path adds nothing.
     trace: Optional[tuple] = None
+    # submitting process's label (_conn_label) — flows onto the task's
+    # return objects as their owner for `ray_tpu memory` attribution
+    owner: str = ""
     # submitted through the bulk SUBMIT_TASKS frame (RemoteFunction.map):
     # the caller declared a homogeneous throughput-oriented fan-out, so
     # the scheduler may pipeline it behind busy workers. Individually
@@ -586,6 +596,25 @@ class Hub:
         # hub stage — the per-call `from ..util.tracing import ...`
         # lookup was measurable at sampling 1.0 (tracing_overhead row)
         self._make_runtime_record = make_runtime_record
+        # ---- sampling profiler (profiling.py): folded collapsed-stack
+        # counts from every process's PROFILE_BATCH flushes, keyed
+        # (pid, proc kind, thread domain, stage, task, stack). Bounded
+        # at profile_store_max distinct keys; overflow samples are
+        # counted in _profile_drops, never stored (GL009).
+        self.profile_samples: Dict[tuple, int] = {}
+        self.profile_procs: Dict[int, dict] = {}
+        self._profile_drops = 0
+        # the hub process's OWN sampler (started in _seed_timers when
+        # config-gated on) hands batches over through this SPSC ring:
+        # sampler thread appends, control thread drains on a timer —
+        # the same single-writer hand-off as the shard rings (GL013)
+        self._profile_inbox: deque = deque()
+        self._profiler = None
+        # parked `ray_tpu stack` requests awaiting a worker's
+        # STACK_REPLY: token -> (requester conn, req_id, worker, pid);
+        # bounded and timer-expired
+        self._stack_waiters: Dict[int, tuple] = {}
+        self._stack_token = itertools.count(1)
         self.driver_conn = None
         self._running = True
         self._dispatching = False
@@ -848,6 +877,23 @@ class Hub:
             self._chaos.arm()
             if self._chaos.timed:
                 self._add_timer(0.05, self._chaos_tick)
+        # hub-process sampler (profiling.py; default off — with
+        # profile_hz 0 maybe_start creates nothing and no timer is
+        # armed). In the local driver the process sampler may already
+        # belong to the driver client; first caller wins and both sinks
+        # see the same threads.
+        from . import profiling as _profiling
+
+        self._profiler = _profiling.maybe_start(
+            "hub", self._profile_inbox.append,
+            hz=self.config.get("profile_hz", 0.0),
+            budget=self.config.get("profile_overhead_budget", 0.03),
+            flush_period=self.config.get("profile_flush_period_s", 1.0),
+        )
+        if self._profiler is not None:
+            self._add_timer(
+                self._profiler.flush_period, self._drain_profile_inbox
+            )
 
     def _teardown_runtime(self) -> None:
         """Shared epilogue: stop workers/agents and flush the last
@@ -860,6 +906,11 @@ class Hub:
         # Drop pending one-shot timers: after teardown their callbacks
         # would fire into freed worker/agent tables (GL016).
         self.timers.clear()
+        if self._profiler is not None:
+            from . import profiling as _profiling
+
+            _profiling.stop()
+            self._profiler = None
 
     def _run_sharded(self):
         """State-plane main loop (n_shards > 1): reactor shards own the
@@ -1749,18 +1800,51 @@ class Hub:
                 return w.node_id
         return "node0"  # driver and hub live on the head node
 
+    def _conn_label(self, conn) -> str:
+        """Stable human-readable identity of a peer for ownership
+        attribution: a worker id, "driver", "client-N" (HELLO order),
+        or "hub" for hub-internal calls (conn=None)."""
+        if conn is None:
+            return "hub"
+        wid = self.conn_to_worker.get(conn)
+        if wid is not None:
+            return wid
+        if conn is self.driver_conn:
+            return "driver"
+        ent = self.client_conns.get(conn)
+        if ent is not None:
+            return f"client-{ent[0]}"
+        return ""
+
+    def _owner_alive(self, owner: str) -> bool:
+        """Does the owning process still hold a live control conn? A
+        ready object whose owner is gone can never be released by
+        owner-side GC — `ray_tpu memory --leak-suspects` keys on this.
+        Unknown/placeholder owners count as alive (no false alarms)."""
+        if not owner or owner == "hub":
+            return True
+        if owner == "driver":
+            return self.driver_conn is not None
+        if owner.startswith("client-"):
+            return any(
+                f"client-{seq}" == owner
+                for seq, _t in self.client_conns.values()
+            )
+        w = self.workers.get(owner)
+        return w is not None and w.conn is not None
+
     def _on_put(self, conn, p):
         tr = p.get("trace")
         if tr is None:
             self._object_ready(
                 p["object_id"], p["kind"], p["payload"], p.get("size", 0),
-                node_id=self._conn_node(conn),
+                node_id=self._conn_node(conn), owner=self._conn_label(conn),
             )
             return
         t0 = time.monotonic()
         self._object_ready(
             p["object_id"], p["kind"], p["payload"], p.get("size", 0),
-            node_id=self._conn_node(conn),
+            node_id=self._conn_node(conn), owner=self._conn_label(conn),
         )
         self._emit_runtime_span(
             "hub.put", "put", (tr[0], tr[1]), t0, time.monotonic(),
@@ -1768,10 +1852,12 @@ class Hub:
         )
 
     def _object_ready(self, oid: bytes, kind: str, payload: Any, size: int,
-                      node_id: str = "node0"):
+                      node_id: str = "node0", owner: str = ""):
         e = self.objects.get(oid)
         if e is None:
             e = self.objects[oid] = ObjEntry()
+        if owner and not e.owner:
+            e.owner = owner
         if e.ready:
             return
         e.ready, e.kind, e.payload, e.size = True, kind, payload, size
@@ -2652,6 +2738,119 @@ class Hub:
                     pair[1] += 1
                     break
 
+    # ----- sampling profiler ingest (profiling.py): every process's
+    # sampler folds locally and flushes PROFILE_BATCH once a flush
+    # period; the hub is the aggregation point list_state("profile")
+    # and `ray_tpu profile` read from.
+    def _drain_profile_inbox(self) -> None:
+        # hub's own sampler hands batches over via the SPSC inbox
+        # (sampler thread appends, this thread drains) — same
+        # discipline as the shard rings
+        while True:
+            try:
+                batch = self._profile_inbox.popleft()
+            except IndexError:
+                break
+            self._on_profile_batch(None, batch)
+        if self._profiler is not None:
+            self._add_timer(
+                self._profiler.flush_period, self._drain_profile_inbox
+            )
+
+    def _on_profile_batch(self, conn, p):
+        pid = p.get("pid")
+        kind = p.get("kind") or "?"
+        samples = p.get("samples") or {}
+        cap = int(self.config.get("profile_store_max", 4096) or 4096)
+        for key, n in samples.items():
+            if not (isinstance(key, tuple) and len(key) == 4):
+                continue
+            skey = (pid, kind) + key
+            if skey in self.profile_samples:
+                self.profile_samples[skey] += n
+            elif len(self.profile_samples) < cap:
+                # bounded by profile_store_max with drops counter below
+                self.profile_samples[skey] = n  # graftlint: disable=GL009
+            else:
+                # cap reached: count what we shed so the CLI can say
+                # "N samples dropped" instead of silently under-reporting
+                self._profile_drops += n
+        while len(self.profile_procs) >= 256 and pid not in self.profile_procs:
+            self.profile_procs.pop(next(iter(self.profile_procs)))
+        self.profile_procs[pid] = {
+            "kind": kind,
+            "overhead": float(p.get("overhead") or 0.0),
+            "hz": float(p.get("hz") or 0.0),
+            "last_t": time.monotonic(),
+        }
+        self._bm(
+            "ray_tpu_profiler_overhead_ratio", "gauge",
+            "sampling profiler self-overhead (sample-pass time / wall)",
+            (("pid", str(pid)),),
+        )["value"] = float(p.get("overhead") or 0.0)
+
+    # ----- remote stack dumps (`ray_tpu stack`): works with the
+    # profiler OFF — the hub dumps its own threads inline; a worker
+    # dump parks the request on a token and forwards STACK_DUMP, whose
+    # STACK_REPLY is matched back here (timer-expired, bounded).
+    def _on_stack_request(self, conn, p):
+        target = str(p.get("target") or "hub")
+        req_id = p.get("req_id")
+        if target in ("hub", "head") or target == str(os.getpid()):
+            from . import profiling as _profiling
+
+            self._reply(
+                conn, req_id, target="hub", pid=os.getpid(),
+                threads=_profiling.dump_threads(),
+            )
+            return
+        w = None
+        for wid, entry in self.workers.items():
+            if wid == target or wid.startswith(target):
+                w = entry
+                break
+        if w is None and target.isdigit():
+            for entry in self.workers.values():
+                if entry.pid == int(target):
+                    w = entry
+                    break
+        if w is None or w.conn is None:
+            self._reply(
+                conn, req_id, target=target, threads=[],
+                error=f"no live worker matches {target!r}",
+            )
+            return
+        if len(self._stack_waiters) >= 256:
+            tok0 = next(iter(self._stack_waiters))
+            self._stack_timeout(tok0)
+        token = next(self._stack_token)
+        self._stack_waiters[token] = (  # graftlint: disable=GL009
+            conn, req_id, w.worker_id, w.pid,
+        )
+        self._send(w.conn, P.STACK_DUMP, {"token": token})
+        self._add_timer(5.0, lambda t=token: self._stack_timeout(t))
+
+    def _stack_timeout(self, token: int) -> None:
+        waiter = self._stack_waiters.pop(token, None)
+        if waiter is None:
+            return
+        conn, req_id, wid, _pid = waiter
+        self._reply(
+            conn, req_id, target=wid, threads=[],
+            error=f"stack dump of {wid} timed out",
+        )
+
+    def _on_stack_reply(self, conn, p):
+        waiter = self._stack_waiters.pop(p.get("token"), None)
+        if waiter is None:
+            return  # late reply after timeout — already answered
+        rconn, req_id, wid, wpid = waiter
+        self._reply(
+            rconn, req_id, target=wid,
+            pid=p.get("pid") or wpid,
+            threads=p.get("threads") or [],
+        )
+
     # ----- task events (reference: core_worker/task_event_buffer.h;
     # feeds list_state("tasks") + the chrome-trace timeline)
     def _task_event(self, task_id: bytes, **fields) -> dict:
@@ -2784,6 +2983,7 @@ class Hub:
             resources=p["resources"],
             options=p["options"],
             retries_left=p["options"].get("max_retries", 3),
+            owner=self._conn_label(conn),
         )
         tr = p.get("trace")
         if tr is None:
@@ -2814,6 +3014,7 @@ class Hub:
         retries = base_opts.get("max_retries", 3)
         tr = p.get("trace")
         t0 = time.monotonic()
+        owner_label = self._conn_label(conn)
         fresh: List[TaskSpec] = []
         for t in p["tasks"]:
             if t["task_id"] in self._task_event_index:
@@ -2832,6 +3033,7 @@ class Hub:
                 # frame's dict across specs would cross-contaminate
                 options=dict(base_opts),
                 retries_left=retries,
+                owner=owner_label,
                 # bulk pipelining is an opt-IN the explicit bulk paths
                 # (map/submit_many) keep by default; auto-batched plain
                 # .remote() frames splice "pipeline": False so strict
@@ -3724,8 +3926,11 @@ class Hub:
                 name=ev.get("name", ""),
                 **({"trace_id": ev["trace_id"]} if "trace_id" in ev else {}),
             )
+        owner_spec = spec if spec is not None else ispec
+        owner_label = owner_spec.owner if owner_spec is not None else ""
         for oid, kind, payload, size in p["returns"]:
-            self._object_ready(oid, kind, payload, size, node_id=node_id)
+            self._object_ready(oid, kind, payload, size, node_id=node_id,
+                               owner=owner_label)
         if tr is not None:
             # completion handling: return registration + readiness
             # fan-out (get/wait waiters, pushes) for this task
@@ -3900,6 +4105,7 @@ class Hub:
             is_actor_create=True,
             actor_id=p["actor_id"],
             ready_id=p["ready_id"],
+            owner=self._conn_label(conn),
         )
         self._admit(spec, p.get("arg_deps", []))
 
@@ -3965,6 +4171,7 @@ class Hub:
             options=p["options"],
             actor_id=p["actor_id"],
             method=p["method"],
+            owner=self._conn_label(conn),
         )
         tr = p.get("trace")
         if tr is not None:
@@ -5188,8 +5395,43 @@ class Hub:
                     "bundle_chips": [list(c) for c in g.bundle_chips],
                 })
         elif kind == "objects":
+            now_mono = time.monotonic()
             for oid, e in self.objects.items():
-                items.append({"object_id": oid.hex(), "ready": e.ready, "size": e.size, "kind": e.kind})
+                items.append({
+                    "object_id": oid.hex(), "ready": e.ready,
+                    "size": e.size, "kind": e.kind,
+                    "node_id": e.node_id,
+                    "owner": e.owner,
+                    "owner_alive": self._owner_alive(e.owner),
+                    "age_s": max(0.0, now_mono - e.created_t),
+                    "pins": e.pins,
+                    "spilled": e.spilled,
+                })
+        elif kind == "profile":
+            # folded profiler samples + per-process sampler meta rows.
+            # Task names join through the task-event index (both sides
+            # key on hex task ids).
+            names: Dict[str, str] = {}
+            for ev in self.task_events:
+                nm = ev.get("name")
+                if nm:
+                    names[ev["task_id"]] = nm
+            for skey, n in self.profile_samples.items():
+                pid, pkind, domain, stage, task, stack = skey
+                items.append({
+                    "pid": pid, "kind": pkind, "thread": domain,
+                    "stage": stage, "task_id": task,
+                    "task_name": names.get(task, ""),
+                    "stack": stack, "samples": n,
+                })
+            now_mono = time.monotonic()
+            for pid, meta in self.profile_procs.items():
+                items.append({
+                    "proc": True, "pid": pid, "kind": meta["kind"],
+                    "overhead": meta["overhead"], "hz": meta["hz"],
+                    "idle_s": max(0.0, now_mono - meta["last_t"]),
+                    "drops": self._profile_drops,
+                })
         elif kind == "demand":
             # pending resource demand by shape (reference: the load the
             # raylet reports to the GCS for the autoscaler,

@@ -73,7 +73,7 @@ SCHEDULER_MSGS = frozenset({
     "pg_ready", "get_actor", "register_job", "register_node",
     "worker_exited", "node_heartbeat", "register_function", "get_function",
     "cluster_resources", "list_state", "shutdown", "span_record",
-    "metric_record",
+    "metric_record", "profile_batch", "stack_request", "stack_reply",
 })
 OBJECT_MSGS = frozenset({
     "put", "get", "wait", "free", "release_owned", "resolve_object",

@@ -97,6 +97,28 @@ SPAN_RECORD = "span_record"
 # set / histogram observe); the hub folds it into its metric registry
 METRIC_RECORD = "metric_record"
 
+# any process -> hub: one flush of the sampling profiler's locally
+# folded stacks (profiling.py — opt-in via RAY_TPU_PROFILE_HZ, default
+# off: with the sampler never started this message type never appears
+# on the wire). Payload: {pid, kind ("driver"/"worker"/"hub"/...),
+# samples: {collapsed-stack-key: count}, overhead, hz} — the hub folds
+# the deltas into its bounded profile store (list_state("profile"))
+# and exports the per-process overhead ratio as a builtin gauge.
+PROFILE_BATCH = "profile_batch"
+
+# on-demand all-thread stack dumps (`ray_tpu stack`, reference: `ray
+# stack` / py-spy dump). No profiler needed — the dump reads
+# sys._current_frames() at request time.
+STACK_REQUEST = "stack_request"  # client -> hub: {target, req_id} where
+                                 # target is "hub", a worker id, or a
+                                 # pid; hub-target answered inline,
+                                 # otherwise forwarded as STACK_DUMP
+STACK_DUMP = "stack_dump"        # hub -> worker/client: {token} — dump
+                                 # your threads and reply STACK_REPLY
+STACK_REPLY = "stack_reply"      # process -> hub: {token, threads:
+                                 # [{thread, daemon, frames}, ...]} —
+                                 # routed back to the parked requester
+
 # streaming generators (reference: _raylet.pyx:280 ObjectRefGenerator)
 STREAM_YIELD = "stream_yield"    # worker -> hub: one yielded value
 STREAM_END = "stream_end"        # worker -> hub: generator exhausted/raised

@@ -208,6 +208,12 @@ def shutdown() -> None:
     with _lock:
         if _is_worker:
             return
+        # the driver-process sampler (started by the client or the
+        # in-process hub) must die with the cluster, or a later init()
+        # in the same interpreter would profile into a dead sink
+        from . import profiling as _profiling
+
+        _profiling.stop()
         if _client is not None:
             _client.close()
             _client = None

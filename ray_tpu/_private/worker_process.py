@@ -32,6 +32,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
+from . import profiling as _prof
 from . import protocol as P
 from .client import CoreClient
 from .serialization import dumps_inline, loads_function, loads_inline
@@ -382,6 +383,8 @@ class WorkerRuntime:
         from ..runtime_context import _current_pg, _current_task_id
 
         _current_task_id.set(p.get("task_id"))
+        if _prof._ACTIVE:  # sample attribution for pool threads
+            _prof.set_task(p.get("task_id"))
         _current_pg.set(getattr(self, "actor_pg", None))
         self._adopt_job_identity(p)
         self._chaos_stall()
@@ -492,6 +495,13 @@ class WorkerRuntime:
             loop = self._ensure_aio_loop()
 
             async def run():
+                # coroutines interleave on the one aio thread, so the
+                # thread-keyed register is last-writer-wins: a sample
+                # lands on whichever call most recently resumed — the
+                # one holding the loop between awaits, which is the one
+                # burning the CPU being sampled
+                if _prof._ACTIVE:
+                    _prof.set_task(p.get("task_id"))
                 tr = p.get("trace")
                 et = _ExecTrace(self.client, tr) if tr is not None else None
                 try:
@@ -895,6 +905,8 @@ def main():
             msg_type, payload = client.task_queue.get()
             if isinstance(payload, dict) and "task_id" in payload:
                 _current_task_id.set(payload["task_id"])
+                if _prof._ACTIVE:  # sample attribution (profiler on)
+                    _prof.set_task(payload["task_id"])
             if msg_type == P.KILL:
                 # a just-finished task's TASK_DONE may still sit in the
                 # async send buffer (_send_done batching) — flush so the

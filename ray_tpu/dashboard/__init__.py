@@ -19,6 +19,11 @@ chrome-trace timeline, and job submission/inspection:
                                  route) metric rows + the per-deployment
                                  summary (latency percentiles, batch
                                  efficiency, drain/drop counters)
+    GET  /api/profile            folded profiler samples + per-process
+                                 sampler meta (empty unless
+                                 RAY_TPU_PROFILE_HZ > 0 somewhere);
+                                 ?fold=1 returns flamegraph collapsed
+                                 text instead of JSON
     GET  /metrics                Prometheus text (user + ray_tpu_* builtin)
     GET  /api/jobs               scheduler view: {tenants (usage vs
                                  quota), jobs (fairsched registry),
@@ -80,7 +85,7 @@ class Dashboard:
             allowed = {
                 "nodes", "actors", "tasks", "workers", "objects",
                 "placement_groups", "events", "tenants", "shards",
-                "traces",
+                "traces", "profile",
             }
             if kind not in allowed:
                 raise web.HTTPNotFound(text=f"unknown kind {kind}")
@@ -121,6 +126,17 @@ class Dashboard:
 
             return web.Response(text=prometheus_text(),
                                 content_type="text/plain")
+
+        async def profile_state(request):
+            rows = self._client().list_state("profile")
+            if request.query.get("fold"):
+                from ray_tpu.util.profiler import fold_lines
+
+                return web.Response(
+                    text="\n".join(fold_lines(rows)) + "\n",
+                    content_type="text/plain",
+                )
+            return web.json_response(rows)
 
         async def serve_state(request):
             from ray_tpu.util.state import summarize_serve
@@ -197,6 +213,7 @@ class Dashboard:
         app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
         app.router.add_get("/api/traces/{trace_id}", trace_detail)
         app.router.add_get("/api/serve", serve_state)
+        app.router.add_get("/api/profile", profile_state)
         app.router.add_get("/api/{kind}", list_kind)
         app.router.add_get("/metrics", metrics)
 

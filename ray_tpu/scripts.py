@@ -17,7 +17,9 @@ external CLI framework.
     python -m ray_tpu trace <trace_id>                # critical path
     python -m ray_tpu chaos                           # fault injection
     python -m ray_tpu timeline --output /tmp/tl.json
-    python -m ray_tpu memory
+    python -m ray_tpu memory --leak-suspects
+    python -m ray_tpu stack <worker-id|hub|pid>       # remote stacks
+    python -m ray_tpu profile --duration 5 --fold out.txt
     python -m ray_tpu job submit -- python train.py
     python -m ray_tpu job logs <id>
     python -m ray_tpu debug
@@ -251,7 +253,9 @@ _LIST_COLUMNS = {
     "tasks": ["task_id", "name", "state", "worker_id"],
     "workers": ["worker_id", "node_id", "pid", "state"],
     "nodes": ["node_id", "alive", "hostname"],
-    "objects": ["object_id", "size_bytes", "location"],
+    "objects": ["object_id", "kind", "size", "owner", "owner_alive",
+                "age_s", "pins", "ready", "spilled"],
+    "profile": ["pid", "kind", "thread", "stage", "task_name", "samples"],
     "placement_groups": ["pg_id", "state", "strategy"],
     "jobs": ["job_id", "tenant", "priority", "quota", "submitted",
              "dispatched", "preempted"],
@@ -521,10 +525,116 @@ def cmd_timeline(args) -> None:
 
 
 def cmd_memory(args) -> None:
+    """Object-store view with leak attribution: one row per object
+    (owner process, age, size, pins) plus the aggregate summary.
+    --leak-suspects keeps only ready objects whose owner is GONE and
+    that nothing pins — refs no live process can ever release."""
     from ray_tpu.util import state as state_api
 
     _connect(args)
-    print(json.dumps(state_api.summarize_objects(), indent=2, default=str))
+    objects = state_api.list_objects()
+    if args.leak_suspects:
+        objects = state_api.leak_suspects(
+            min_age_s=args.min_age, objects=objects
+        )
+    if args.format == "json":
+        print(json.dumps(
+            {"objects": objects,
+             "summary": state_api.summarize_objects()},
+            indent=2, default=str,
+        ))
+        return
+    rows = [
+        {
+            "object_id": o.get("object_id", "")[:16],
+            "kind": o.get("kind", ""),
+            "size": o.get("size", 0),
+            "owner": o.get("owner") or "?",
+            "alive": "yes" if o.get("owner_alive", True) else "NO",
+            "age_s": f"{o.get('age_s', 0.0):.1f}",
+            "pins": o.get("pins", 0),
+            "ready": o.get("ready"),
+            "spilled": o.get("spilled"),
+        }
+        for o in sorted(
+            objects, key=lambda o: o.get("age_s", 0.0), reverse=True
+        )
+    ]
+    _print_table(rows, ["object_id", "kind", "size", "owner", "alive",
+                        "age_s", "pins", "ready", "spilled"])
+    summary = state_api.summarize_objects()
+    print(
+        f"\n{summary['ready']}/{summary['total']} ready, "
+        f"{summary['total_size_bytes']} bytes, "
+        f"{summary['spilled']} spilled, "
+        f"{summary['leak_suspects']} leak suspect(s)"
+    )
+
+
+def cmd_stack(args) -> None:
+    """On-demand all-thread stack dump of the hub or a worker — the
+    profiler does not need to be on (reference: `ray stack`)."""
+    from ray_tpu.util import profiler as prof_api
+
+    _connect(args)
+    reply = prof_api.stack(args.target, timeout=args.timeout)
+    sys.stdout.write(prof_api.format_stack(reply))
+    if reply.get("error"):
+        raise SystemExit(1)
+
+
+def cmd_profile(args) -> None:
+    """Window the cluster-wide sampling profiler over --duration
+    seconds and report: a stage/task/thread top table, and/or the raw
+    flamegraph collapsed stacks (--fold FILE, '-' for stdout)."""
+    from ray_tpu.util import profiler as prof_api
+
+    _connect(args)
+    print(f"profiling for {args.duration:.1f}s ...", file=sys.stderr)
+    rows = prof_api.profile(args.duration)
+    samples = [r for r in rows if not r.get("proc")]
+    procs = prof_api.overhead(rows)
+    if not samples:
+        print(
+            "no samples collected. Is the profiler on? Start the "
+            "cluster with RAY_TPU_PROFILE_HZ=<rate> (e.g. 50) — the "
+            "sampler is off by default.",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    if args.fold:
+        lines = prof_api.fold_lines(samples)
+        if args.fold == "-":
+            sys.stdout.write("\n".join(lines) + "\n")
+        else:
+            with open(args.fold, "w") as f:
+                f.write("\n".join(lines) + "\n")
+            print(f"wrote {len(lines)} folded stacks to {args.fold}")
+    if args.top or not args.fold:
+        by = args.top or "stage"
+        total = sum(r.get("samples", 0) for r in samples)
+        print(f"\n{total} samples by {by}:")
+        _print_table(
+            [
+                dict(r, share=f"{r['share'] * 100:.1f}%")
+                for r in prof_api.top(samples, by=by, n=args.limit)
+            ],
+            [by, "samples", "share"],
+        )
+    if procs:
+        print("\nsamplers:")
+        _print_table(
+            [
+                {
+                    "pid": m.get("pid"), "kind": m.get("kind"),
+                    "hz": m.get("hz"),
+                    "overhead": f"{m.get('overhead', 0.0) * 100:.2f}%",
+                    "drops": m.get("drops", 0),
+                }
+                for m in procs
+            ],
+            ["pid", "kind", "hz", "overhead", "drops"],
+        )
 
 
 def cmd_job(args) -> None:
@@ -683,7 +793,7 @@ def _build_parser() -> argparse.ArgumentParser:
         "kind",
         choices=["actors", "tasks", "workers", "nodes", "objects",
                  "placement_groups", "pgs", "jobs", "tenants", "shards",
-                 "traces", "chaos"],
+                 "traces", "chaos", "profile"],
     )
     sp.add_argument("--format", choices=["table", "json"], default="table")
     add_address(sp)
@@ -731,9 +841,47 @@ def _build_parser() -> argparse.ArgumentParser:
     add_address(sp)
     sp.set_defaults(fn=cmd_timeline)
 
-    sp = sub.add_parser("memory", help="object store summary")
+    sp = sub.add_parser(
+        "memory", help="object store: per-object owner/age/size rows "
+                       "+ leak suspects"
+    )
+    sp.add_argument("--leak-suspects", action="store_true",
+                    help="only ready objects whose owner process is "
+                         "gone and that no in-flight task pins")
+    sp.add_argument("--min-age", type=float, default=60.0,
+                    help="leak-suspect age floor in seconds")
+    sp.add_argument("--format", choices=["table", "json"], default="table")
     add_address(sp)
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser(
+        "stack", help="all-thread stack dump of the hub or a worker "
+                      "(no profiler needed)"
+    )
+    sp.add_argument("target", nargs="?", default="hub",
+                    help='"hub" (default), a worker id (prefix ok), '
+                         "or a worker pid")
+    sp.add_argument("--timeout", type=float, default=10.0)
+    add_address(sp)
+    sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser(
+        "profile", help="sample the cluster for N seconds and report "
+                        "folded stacks / stage tops (needs "
+                        "RAY_TPU_PROFILE_HZ > 0)"
+    )
+    sp.add_argument("--duration", type=float, default=5.0)
+    sp.add_argument("--fold", default=None, metavar="FILE",
+                    help="write flamegraph collapsed stacks ('-' = "
+                         "stdout)")
+    sp.add_argument("--top", default=None,
+                    choices=["stage", "task", "thread", "kind", "stack"],
+                    help="aggregate table dimension (default: stage "
+                         "when --fold is not given)")
+    sp.add_argument("--limit", type=int, default=20,
+                    help="top-table row cap")
+    add_address(sp)
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("job", help="job submission")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

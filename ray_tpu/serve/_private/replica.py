@@ -47,6 +47,11 @@ class Replica:
         # lets @serve.batch queues and multiplex wrappers (which never
         # see the Replica) tag their metrics with this deployment
         obs.set_current_deployment(deployment_name)
+        # profiler attribution: this worker process's samples read
+        # worker:serve:<deployment> instead of bare "worker"
+        from ..._private import profiling as _profiling
+
+        _profiling.set_process_label(f"serve:{deployment_name}")
         cls = serialized_cls
         if callable(cls) and not inspect.isclass(cls):
             # function deployment: wrap into a callable object

@@ -177,13 +177,27 @@ def _escape_help(text: str) -> str:
     return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
+# last successful rendering: a scrape racing hub teardown (or hitting a
+# partitioned head) serves stale-but-well-formed exposition instead of a
+# 500 — Prometheus treats a failed scrape as a gap, but an exception
+# here used to take the whole dashboard handler down with it
+_last_exposition = ""
+
+
 def prometheus_text() -> str:
     """Render the registry in Prometheus exposition format (the
     reference exports via its metrics agent to Prometheus; here the
-    caller mounts this on whatever HTTP surface it likes)."""
+    caller mounts this on whatever HTTP surface it likes). Degrades
+    gracefully when the hub is unreachable: returns the last successful
+    exposition (or an empty one) rather than raising."""
+    global _last_exposition
+    try:
+        metrics = snapshot()
+    except Exception:
+        return _last_exposition
     lines: List[str] = []
     seen_help = set()
-    for m in snapshot():
+    for m in metrics:
         name = _sanitize_name(m["name"])
         if name not in seen_help:
             seen_help.add(name)
@@ -209,4 +223,5 @@ def prometheus_text() -> str:
             lines.append(f"{name}_count{suffix} {m['count']}")
         else:
             lines.append(f"{name}{suffix} {m['value']}")
-    return "\n".join(lines) + "\n"
+    _last_exposition = "\n".join(lines) + "\n"
+    return _last_exposition

@@ -85,6 +85,15 @@ def list_serve(filters: Optional[list] = None) -> List[dict]:
     return _apply_filters(_client().list_state("serve"), filters)
 
 
+def list_profile(filters: Optional[list] = None) -> List[dict]:
+    """Folded profiler samples aggregated at the hub (profiling.py):
+    one row per distinct (pid, process kind, thread domain, stage,
+    task, collapsed stack) with its sample count, plus one meta row per
+    reporting process (proc=True: kind, hz, self-overhead ratio).
+    Empty unless RAY_TPU_PROFILE_HZ > 0 somewhere in the cluster."""
+    return _apply_filters(_client().list_state("profile"), filters)
+
+
 def get_trace(trace_id: str) -> List[dict]:
     """All recorded spans of one trace, raw (feed these through
     ray_tpu.util.tracing.analyze_trace for the critical-path view)."""
@@ -243,11 +252,38 @@ def summarize_actors() -> Dict[str, Any]:
     }
 
 
+def leak_suspects(min_age_s: float = 60.0,
+                  objects: Optional[List[dict]] = None) -> List[dict]:
+    """Ready objects that look leaked: their owning process is gone
+    (nothing can ever release the ref), no in-flight task pins them,
+    and they have been alive at least min_age_s. Backs
+    `ray_tpu memory --leak-suspects`."""
+    if objects is None:
+        objects = _client().list_state("objects")
+    return [
+        o for o in objects
+        if o.get("ready")
+        and not o.get("owner_alive", True)
+        and not o.get("pins", 0)
+        and o.get("age_s", 0.0) >= min_age_s
+    ]
+
+
 def summarize_objects() -> Dict[str, Any]:
     objects = _client().list_state("objects")
     ready = [o for o in objects if o.get("ready")]
+    by_owner: Dict[str, Dict[str, Any]] = {}
+    for o in ready:
+        ow = by_owner.setdefault(o.get("owner") or "?", {
+            "count": 0, "size_bytes": 0,
+        })
+        ow["count"] += 1
+        ow["size_bytes"] += o.get("size", 0)
     return {
         "total": len(objects),
         "ready": len(ready),
         "total_size_bytes": sum(o.get("size", 0) for o in ready),
+        "spilled": sum(1 for o in ready if o.get("spilled")),
+        "by_owner": by_owner,
+        "leak_suspects": len(leak_suspects(objects=objects)),
     }

@@ -306,11 +306,32 @@ def analyze_trace(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
     (each instant charged to exactly one stage), ``result_return`` is
     recomputed as the tail of the enveloping client get span past the
     last runtime stage, and whatever no span covers is reported as
-    ``untracked_s`` — stages + untracked always sum to end_to_end_s."""
+    ``untracked_s`` — stages + untracked always sum to end_to_end_s.
+
+    The input is whatever the hub retained — a trace truncated by
+    eviction or a crashing process can contain orphan spans (parent_id
+    never recorded; irrelevant here, the sweep does not walk parents),
+    spans missing or corrupting their start/end stamps, and
+    zero-duration stages. Malformed spans are dropped (counted in
+    ``malformed_spans``) and the analysis proceeds on the rest — a
+    partial report, never an exception."""
+    raw = spans
+
+    def _ok(s: Any) -> bool:
+        if not isinstance(s, dict):
+            return False
+        a, b = s.get("start"), s.get("end")
+        return (
+            isinstance(a, (int, float)) and isinstance(b, (int, float))
+            and not isinstance(a, bool) and not isinstance(b, bool)
+            and b >= a
+        )
+
+    spans = [s for s in raw if _ok(s)]
     if not spans:
-        return {"trace_id": None, "n_spans": 0, "end_to_end_s": 0.0,
+        return {"trace_id": None, "n_spans": len(raw), "end_to_end_s": 0.0,
                 "stages": {}, "dominant_stage": None, "untracked_s": 0.0,
-                "processes": []}
+                "processes": [], "malformed_spans": len(raw)}
     t_start = min(s["start"] for s in spans)
     t_end = max(s["end"] for s in spans)
     e2e = max(0.0, t_end - t_start)
@@ -354,7 +375,8 @@ def analyze_trace(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
     dominant = max(stages, key=stages.get) if stages else None
     return {
         "trace_id": spans[0].get("trace_id"),
-        "n_spans": len(spans),
+        "n_spans": len(raw),
+        "malformed_spans": len(raw) - len(spans),
         "end_to_end_s": e2e,
         "stages": {
             st: {"dur_s": dur, "share": (dur / e2e) if e2e > 0 else 0.0}

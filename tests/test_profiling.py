@@ -1,0 +1,398 @@
+"""Sampling profiler + remote stack dumps (profiling.py, util/profiler).
+
+Reference surfaces: `ray stack` and the dashboard's py-spy profiling
+endpoints — here re-done in-process. Covers the frame classifier, the
+sampler lifecycle, hub aggregation with per-task attribution, the
+zero-cost-when-off guard the tier-1 suite enforces, and the CLI verbs.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import profiling
+
+
+def _wait_for(cond, timeout=15):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+# ------------------------------------------------------------- classifier
+def test_classify_stage_buckets():
+    pkg = profiling._PKG_DIR
+    assert profiling.classify_stage(
+        [(f"{pkg}/_private/serialization.py", "dumps_frame")]
+    ) == "frame-encode"
+    assert profiling.classify_stage(
+        [("/usr/lib/python3.10/pickle.py", "dump")]
+    ) == "serialize"
+    assert profiling.classify_stage(
+        [("/usr/lib/python3.10/selectors.py", "select")]
+    ) == "reactor-poll"
+    assert profiling.classify_stage(
+        [("/usr/lib/python3.10/socket.py", "recv_into")]
+    ) == "recv/send"
+    assert profiling.classify_stage(
+        [("/usr/lib/python3.10/threading.py", "wait"),
+         ("/home/user/app.py", "work")]
+    ) == "lock-wait"
+    assert profiling.classify_stage(
+        [("/home/user/train.py", "step")]
+    ) == "user-code"
+    # REPL/exec-defined user functions keep their synthetic filename
+    assert profiling.classify_stage([("<stdin>", "burn")]) == "user-code"
+    # runtime-internal frames only -> runtime
+    assert profiling.classify_stage(
+        [(f"{pkg}/_private/hub.py", "_dispatch"),
+         ("<frozen importlib._bootstrap>", "_find_and_load")]
+    ) == "runtime"
+    assert profiling.classify_stage([]) == "runtime"
+
+
+def test_classify_stage_idle_vs_lock_wait():
+    pkg = profiling._PKG_DIR
+    # executor parked between tasks: queue.get directly under the
+    # worker dispatch loop is idle, not a lock stall
+    idle_stack = [
+        ("/usr/lib/python3.10/queue.py", "get"),
+        (f"{pkg}/_private/worker_process.py", "main"),
+    ]
+    assert profiling.classify_stage(idle_stack) == "idle"
+    # the same queue.get under user code IS a wait worth surfacing
+    user_wait = [
+        ("/usr/lib/python3.10/queue.py", "get"),
+        ("/home/user/pipeline.py", "consume"),
+    ]
+    assert profiling.classify_stage(user_wait) == "lock-wait"
+
+
+def test_classify_thread_domains():
+    assert profiling.classify_thread("MainThread") == "main"
+    assert profiling.classify_thread("ray-tpu-hub") == "reactor"
+    assert profiling.classify_thread("ray-tpu-hub-shard-2") == "shard"
+    assert profiling.classify_thread("core-client-reader") == "reader"
+    assert profiling.classify_thread("core-client-flusher") == "flusher"
+    assert profiling.classify_thread("ray-tpu-profile-sampler") == "profiler"
+    assert profiling.classify_thread("my-own-thread") == "my-own-thread"
+
+
+def test_collapse_is_root_to_leaf():
+    pairs = [("/a/leaf.py", "inner"), ("/a/mid.py", "call"),
+             ("/a/root.py", "main")]  # leaf-first, as sampled
+    assert profiling._collapse(pairs) == "root:main;mid:call;leaf:inner"
+
+
+# ---------------------------------------------------------------- sampler
+def test_maybe_start_off_creates_nothing(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_PROFILE_HZ", raising=False)
+    before = set(threading.enumerate())
+    assert profiling.maybe_start("test", lambda b: None) is None
+    assert profiling._SAMPLER is None
+    assert not profiling._ACTIVE
+    assert set(threading.enumerate()) == before
+
+
+def test_sampler_folds_and_flushes():
+    batches = []
+    try:
+        s = profiling.maybe_start(
+            "unit", batches.append, hz=200.0, flush_period=0.2
+        )
+        assert s is not None
+        assert profiling._ACTIVE
+        profiling.set_task(b"\xab\xcd")  # this thread shows up attributed
+        spin_until = time.monotonic() + 0.1
+        while time.monotonic() < spin_until:
+            pass  # give the sampler something on-CPU to see
+        assert _wait_for(lambda: batches, timeout=10)
+        batch = batches[0]
+        assert batch["kind"] == "unit"
+        assert batch["pid"] == os.getpid()
+        assert 0.0 <= batch["overhead"] < 1.0
+        assert batch["samples"]
+        key, n = next(iter(batch["samples"].items()))
+        domain, stage, task, stack = key
+        assert stage in profiling.STAGES
+        assert n >= 1
+        # this test thread's samples carry its registered task id
+        assert any(k[2] == "abcd" for k in batch["samples"])
+        # the sampler never samples itself
+        assert all(k[0] != "profiler" for k in batch["samples"])
+    finally:
+        profiling.stop()
+    assert profiling._SAMPLER is None
+    assert not profiling._ACTIVE
+    assert profiling._TASK_REGISTER == {}
+
+
+def test_sampler_auto_clamps_past_budget():
+    try:
+        s = profiling.maybe_start(
+            "clamp", lambda b: None, hz=128.0, budget=1e-9,
+            flush_period=0.2,
+        )
+        assert s is not None
+        # any nonzero sampling cost exceeds the absurd budget: the rate
+        # halves every window down to the 1 Hz floor
+        assert _wait_for(lambda: s.clamped, timeout=10)
+        assert s.hz < 128.0
+        assert s.hz >= 1.0
+    finally:
+        profiling.stop()
+
+
+def test_dump_threads_sees_all_threads():
+    evt = threading.Event()
+    t = threading.Thread(target=evt.wait, name="dumpee", daemon=True)
+    t.start()
+    try:
+        dump = profiling.dump_threads()
+        by_name = {d["thread"]: d for d in dump}
+        assert "MainThread" in by_name
+        assert "dumpee" in by_name
+        frames = "\n".join(by_name["dumpee"]["frames"])
+        assert "evt.wait" in frames or "threading" in frames
+        assert by_name["dumpee"]["daemon"] is True
+    finally:
+        evt.set()
+
+
+# ----------------------------------------------------- report-side helpers
+def _row(pid=1, kind="worker", thread="main", stage="user-code",
+         task_id="", task_name="", stack="a:b;c:d", samples=1):
+    return {"pid": pid, "kind": kind, "thread": thread, "stage": stage,
+            "task_id": task_id, "task_name": task_name, "stack": stack,
+            "samples": samples}
+
+
+def test_profiler_diff_fold_top():
+    from ray_tpu.util import profiler as prof
+
+    before = [_row(samples=5), _row(stage="idle", samples=3)]
+    after = [
+        _row(samples=9),                      # 4 new
+        _row(stage="idle", samples=3),        # unchanged: dropped
+        _row(stage="serialize", samples=2),   # new key
+        {"proc": True, "pid": 1, "kind": "worker", "hz": 50.0,
+         "overhead": 0.01, "drops": 0},
+    ]
+    d = prof.diff(before, after)
+    data = [r for r in d if not r.get("proc")]
+    assert {(r["stage"], r["samples"]) for r in data} == {
+        ("user-code", 4), ("serialize", 2)
+    }
+    assert prof.overhead(d) == [after[-1]]
+
+    lines = prof.fold_lines(
+        [_row(task_id="deadbeef" * 2, task_name="burn", samples=7)]
+    )
+    assert lines == [
+        "worker:1;main;user-code;task:deadbeef (burn);a:b;c:d 7"
+    ]
+
+    tops = prof.top(
+        [_row(stage="user-code", samples=6), _row(stage="idle", samples=2)],
+        by="stage",
+    )
+    assert tops[0] == {"stage": "user-code", "samples": 6, "share": 0.75}
+
+
+# -------------------------------------------------------- live-cluster: off
+def test_profiler_off_is_truly_zero_cost(ray_start_regular):
+    """Tier-1 guard: with RAY_TPU_PROFILE_HZ at its default 0, no
+    sampler thread exists anywhere, no PROFILE_BATCH ever reaches the
+    hub, and the profile state table is empty."""
+    import ray_tpu
+    from ray_tpu.util import profiler as prof
+    from ray_tpu.util.state import list_profile
+
+    assert not profiling._ACTIVE
+    assert profiling._SAMPLER is None
+    assert not any(
+        "profile-sampler" in t.name for t in threading.enumerate()
+    )
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get([f.remote() for _ in range(4)]) == [1] * 4
+    assert list_profile() == []  # no batches arrived, no procs reported
+
+    # a worker's threads, dumped live: no sampler there either
+    from ray_tpu.util.state import list_workers
+
+    assert _wait_for(
+        lambda: any(w.get("pid") for w in list_workers()), timeout=15
+    )
+    wid = next(w["worker_id"] for w in list_workers() if w.get("pid"))
+    dump = prof.stack(wid)
+    assert dump.get("threads") and not dump.get("error")
+    assert not any(
+        "profile-sampler" in t["thread"] for t in dump["threads"]
+    )
+
+
+# --------------------------------------------------------- live-cluster: on
+@pytest.fixture
+def profiled_cluster(monkeypatch):
+    import ray_tpu
+
+    monkeypatch.setenv("RAY_TPU_PROFILE_HZ", "50")
+    monkeypatch.setenv("RAY_TPU_PROFILE_FLUSH_PERIOD_S", "0.3")
+    ctx = ray_tpu.init(num_cpus=2, max_workers=2, ignore_reinit_error=True)
+    yield ctx
+    ray_tpu.shutdown()
+    profiling.stop()  # belt and braces: never leak a sampler into the
+    # next test even if shutdown's path changes
+
+
+def test_profiler_attributes_tasks_and_stages(profiled_cluster):
+    """The acceptance path: a task burst under an active sampler yields
+    samples attributed to a named task id AND a named runtime stage."""
+    import ray_tpu
+    from ray_tpu.util.state import list_profile
+
+    @ray_tpu.remote
+    def burn(sec):
+        t0 = time.time()
+        x = 0
+        while time.time() - t0 < sec:
+            x += sum(i * i for i in range(2000))
+        return x
+
+    refs = [burn.remote(0.4) for _ in range(4)]
+    ray_tpu.get(refs)
+
+    def attributed():
+        rows = [r for r in list_profile() if not r.get("proc")]
+        return [
+            r for r in rows
+            if r["task_id"] and r["task_name"].startswith("burn")
+            and r["stage"] in profiling.STAGES
+        ]
+
+    assert _wait_for(lambda: attributed(), timeout=20)
+    rows = list_profile()
+    samples = [r for r in rows if not r.get("proc")]
+    procs = [r for r in rows if r.get("proc")]
+    # every sampled process reported its meta row: driver + workers
+    assert any(p["kind"] == "driver" or p["kind"] == "hub" for p in procs)
+    assert any(p["kind"] == "worker" for p in procs)
+    assert all(p["hz"] > 0 for p in procs)
+    # stacks are folded root->leaf flamegraph strings
+    assert any(";" in r["stack"] for r in samples)
+    # the self-overhead gauge is live in the metric registry
+    from ray_tpu.util.metrics import snapshot
+
+    assert any(
+        m["name"] == "ray_tpu_profiler_overhead_ratio" for m in snapshot()
+    )
+
+
+def test_profile_window_and_cli(profiled_cluster, tmp_path, capsys):
+    import ray_tpu
+    from ray_tpu import scripts
+    from ray_tpu.util import profiler as prof
+
+    @ray_tpu.remote
+    def spin(sec):
+        t0 = time.time()
+        while time.time() - t0 < sec:
+            sum(i * i for i in range(1000))
+        return 0
+
+    refs = [spin.remote(1.5) for _ in range(2)]
+    rows = prof.profile(1.2)  # windows the burst
+    ray_tpu.get(refs)
+    assert [r for r in rows if not r.get("proc")]
+
+    out = tmp_path / "folded.txt"
+    addr = profiled_cluster.address_info["address"]
+    scripts.main([
+        "profile", "--duration", "1.0", "--fold", str(out),
+        "--top", "stage", "--address", addr,
+    ])
+    text = out.read_text()
+    assert text.strip()
+    # every folded line is "semi;colon;stack count"
+    for line in text.strip().splitlines():
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit()
+    printed = capsys.readouterr().out
+    assert "samples by stage" in printed
+
+
+def test_stack_cli_and_unknown_target(ray_start_regular, capsys):
+    from ray_tpu import scripts
+    from ray_tpu.util import profiler as prof
+
+    addr = ray_start_regular.address_info["address"]
+    scripts.main(["stack", "hub", "--address", addr])
+    out = capsys.readouterr().out
+    assert "MainThread" in out and "pid=" in out
+
+    reply = prof.stack("definitely-not-a-worker")
+    assert reply.get("error")
+    assert reply.get("threads") == []
+
+    with pytest.raises(SystemExit):
+        scripts.main([
+            "stack", "definitely-not-a-worker", "--address", addr,
+        ])
+
+
+# --------------------------------------------------- memory / leak suspects
+def test_objects_owner_age_and_leak_suspects(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ref = ray_tpu.put(b"x" * 128)
+    objs = state_api.list_objects()
+    mine = [o for o in objs if o["object_id"] == ref.hex()]
+    assert mine, objs
+    o = mine[0]
+    assert o["owner"] == "driver"
+    assert o["owner_alive"] is True
+    assert o["age_s"] >= 0.0
+    assert o["size"] >= 128
+
+    summary = state_api.summarize_objects()
+    assert summary["by_owner"]["driver"]["count"] >= 1
+    assert summary["leak_suspects"] == 0
+
+    # a dead owner with no pins IS a suspect; pins or youth exempt it
+    fake = [
+        dict(o, owner="client-9", owner_alive=False, age_s=300.0, pins=0),
+        dict(o, owner="client-9", owner_alive=False, age_s=300.0, pins=2),
+        dict(o, owner="client-9", owner_alive=False, age_s=1.0, pins=0),
+    ]
+    suspects = state_api.leak_suspects(min_age_s=60.0, objects=fake)
+    assert suspects == [fake[0]]
+    del ref
+
+
+def test_memory_cli_table_and_leak_flag(ray_start_regular, capsys):
+    import ray_tpu
+    from ray_tpu import scripts
+
+    ref = ray_tpu.put(b"y" * 64)
+    addr = ray_start_regular.address_info["address"]
+    scripts.main(["memory", "--address", addr])
+    out = capsys.readouterr().out
+    assert "OWNER" in out and "AGE_S" in out
+    assert ref.hex()[:16] in out
+    assert "leak suspect" in out
+
+    scripts.main(["memory", "--leak-suspects", "--address", addr])
+    out = capsys.readouterr().out
+    # live driver-owned objects are filtered out of the suspect view
+    assert ref.hex()[:16] not in out
+    del ref

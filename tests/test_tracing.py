@@ -409,3 +409,60 @@ def test_user_span_durations_survive_wall_step(monkeypatch):
     assert len(recs) == 1
     dur = recs[0]["end"] - recs[0]["start"]
     assert 0.015 <= dur <= 5.0, dur
+
+
+def test_analyze_trace_malformed_spans_partial_report():
+    """A trace truncated by eviction or a crashing process yields a
+    PARTIAL report, never an exception: orphan spans analyze fine
+    (nothing walks parents), spans with missing/corrupt start/end are
+    dropped and counted, zero-duration stages contribute 0s."""
+    from ray_tpu.util.tracing import analyze_trace
+
+    def mk(name, stage, a, b, **kw):
+        d = {"name": name, "trace_id": "t1", "span_id": name,
+             "parent_id": None, "start": a, "end": b, "pid": 1,
+             "node_id": "node0", "attrs": {"stage": stage}}
+        d.update(kw)
+        return d
+
+    spans = [
+        # orphan: parent never recorded — must still be charged
+        mk("hub.sched", "queue_wait", 0.0, 0.5,
+           parent_id="never-recorded"),
+        # zero-duration stage: fine, contributes 0s, no crash
+        mk("hub.dispatch", "dispatch", 0.5, 0.5),
+        mk("worker.execute", "execute", 0.5, 1.1),
+        # missing end stamp (producer died mid-span)
+        {"name": "torn", "trace_id": "t1", "span_id": "x",
+         "start": 0.2, "attrs": {"stage": "execute"}},
+        # corrupt stamps
+        mk("bad.types", "execute", "not-a-number", 1.0),
+        mk("bad.order", "execute", 2.0, 1.0),  # end before start
+        # not even a dict
+        "garbage",
+        None,
+    ]
+    out = analyze_trace(spans)
+    assert out["n_spans"] == len(spans)
+    assert out["malformed_spans"] == 5
+    assert out["dominant_stage"] == "execute"
+    assert abs(out["end_to_end_s"] - 1.1) < 1e-9
+    assert abs(out["stages"]["queue_wait"]["dur_s"] - 0.5) < 1e-9
+    assert abs(out["stages"]["execute"]["dur_s"] - 0.6) < 1e-9
+    assert "dispatch" not in out["stages"] or (
+        out["stages"]["dispatch"]["dur_s"] == 0.0
+    )
+
+
+def test_analyze_trace_all_spans_malformed_never_throws():
+    from ray_tpu.util.tracing import analyze_trace
+
+    out = analyze_trace([
+        {"name": "a"}, {"start": None, "end": None}, 42, "junk",
+        {"start": True, "end": True},  # bools are not timestamps
+    ])
+    assert out["n_spans"] == 5
+    assert out["malformed_spans"] == 5
+    assert out["end_to_end_s"] == 0.0
+    assert out["stages"] == {}
+    assert out["dominant_stage"] is None
