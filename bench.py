@@ -135,7 +135,10 @@ def main() -> None:
         # top-level platform stamp (same contract as bench_core rows):
         # consumers comparing rows must check it before ratioing
         "platform": jax.devices()[0].platform,
-        "vs_baseline": round(mfu / 0.50, 3),
+        # the MFU baseline is accelerator-class hardware; a CPU
+        # fallback's "MFU" (peak=1.0 placeholder) must not masquerade
+        # as a ratio — refuse it, same contract as bench_core.report()
+        "vs_baseline": None if on_cpu else round(mfu / 0.50, 3),
         "detail": {
             "model_params": llama.param_count(cfg),
             "batch": batch, "seq": seq, "steps": steps,

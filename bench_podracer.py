@@ -1,0 +1,235 @@
+"""Podracer throughput benchmarks (rllib/podracer).
+
+Measures end-to-end RL steps/s for both Podracer layouts on the local
+backend, in bench_core conventions — one JSON line per row:
+
+    {"metric": ..., "value": N, "unit": ..., "platform": ..., "vs_baseline": N}
+
+Rows:
+- anakin_steps_per_sec: the co-jitted env+learner loop driven through
+  the compiled-DAG resident exec loop (steady state: compile excluded
+  by a warmup tick, each trial re-ticks the same resident worker).
+- sebulba_steps_per_sec: the actor/learner split — bulk-submitted
+  fragment fan-out, shm object-plane trajectory hand-off, sharded
+  learner with collective-group all-reduce, KV param broadcast.
+  Includes the pipeline's real coupling costs (first trial carries the
+  worker-side jit compile; prefer --trials medians).
+
+Baselines are cpu-box numbers (JAX_PLATFORMS=cpu, 8 virtual devices)
+measured on this repo's CI box at the rows' introduction (PR 20).
+Every row is stamped with the detected platform; vs_baseline is
+refused (null) off the baseline platform — a TPU run of these rows
+must establish its own MULTICHIP baseline, never ratio against cpu.
+
+MULTICHIP status: on a non-cpu backend this harness still runs both
+layouts against the local chips, but the cross-slice topology (SLICE
+placement, per-slice gangs, ICI all-reduce) is a stub until a live
+multi-chip TPU session exists — the run emits a podracer_multichip
+note row instead of silently reporting one-chip numbers as MULTICHIP.
+
+Run: python bench_podracer.py [--quick] [--smoke] [--trials N] [--json PATH]
+(flag semantics identical to bench_core.py; smoke numbers are NOT
+comparable, they exist for tests/test_bench_podracer.py)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from bench_core import _detect_platform, _parse_argv as _core_parse
+
+BASELINES = {
+    # cpu-box numbers, --quick --trials 3 medians at introduction
+    "anakin_steps_per_sec": 61900.0,
+    "sebulba_steps_per_sec": 29700.0,
+}
+
+BASELINE_PLATFORM = "cpu"
+
+SMOKE = False
+QUICK = False
+TRIALS = None
+JSON_PATH = None
+RESULTS = []
+
+
+def _parse_argv(argv) -> None:
+    """bench_core's flag grammar, landed into this module's globals."""
+    global SMOKE, QUICK, TRIALS, JSON_PATH
+    import bench_core
+
+    _core_parse(argv)
+    SMOKE, QUICK = bench_core.SMOKE, bench_core.QUICK
+    TRIALS, JSON_PATH = bench_core.TRIALS, bench_core.JSON_PATH
+
+
+def report(metric: str, value, unit: str) -> None:
+    trials_list = None
+    if isinstance(value, list):  # --trials mode: per-trial samples
+        trials_list = [round(v, 3) for v in value]
+        value = float(np.median(value))
+    platform = _detect_platform()
+    base = BASELINES.get(metric)
+    if platform != BASELINE_PLATFORM:
+        ratio = None  # cpu baselines: never ratio across hardware
+    elif base:
+        ratio = value / base
+    else:
+        ratio = None
+    rec = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "platform": platform,
+        "vs_baseline": round(ratio, 3) if ratio else None,
+    }
+    if trials_list is not None:
+        rec["trials"] = trials_list
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def _multichip_stub(platform: str) -> None:
+    print(json.dumps({
+        "metric": "podracer_multichip",
+        "value": None,
+        "unit": "note",
+        "platform": platform,
+        "note": (
+            "MULTICHIP topology (SLICE-placed per-slice gangs, ICI "
+            "all-reduce) is stubbed: this run measured the local "
+            f"{platform} devices only. Rows above carry "
+            "vs_baseline=null — establish a MULTICHIP baseline before "
+            "comparing."
+        ),
+    }), flush=True)
+
+
+def _anakin_steps_per_sec():
+    from ray_tpu.rllib.podracer import PodracerConfig
+
+    if SMOKE:
+        num_envs, frag, supersteps, ticks = 16, 8, 1, 3
+    elif QUICK:
+        num_envs, frag, supersteps, ticks = 64, 16, 2, 10
+    else:
+        num_envs, frag, supersteps, ticks = 64, 16, 2, 40
+    driver = (
+        PodracerConfig()
+        .environment("CartPole-v1")
+        .podracer(
+            mode="anakin", num_envs=num_envs,
+            anakin_supersteps_per_call=supersteps,
+        )
+        .env_runners(rollout_fragment_length=frag)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        driver.train(num_ticks=1)  # compile + channel warmup
+        samples = [
+            driver.train(num_ticks=ticks)["steps_per_sec"]
+            for _ in range(TRIALS or 1)
+        ]
+    finally:
+        driver.stop()
+    return samples if TRIALS else samples[0]
+
+
+def _sebulba_steps_per_sec():
+    from ray_tpu.rllib.podracer import PodracerConfig
+
+    if SMOKE:
+        actors, envs, frag, shards, rounds = 2, 8, 8, 1, 3
+    elif QUICK:
+        actors, envs, frag, shards, rounds = 2, 16, 32, 2, 8
+    else:
+        actors, envs, frag, shards, rounds = 2, 16, 32, 2, 24
+    driver = (
+        PodracerConfig()
+        .environment("CartPole-v1")
+        .podracer(
+            mode="sebulba", learner_shards=shards,
+            max_inflight_rounds=2, namespace="bench",
+        )
+        .env_runners(
+            num_actors=actors, envs_per_actor=envs,
+            rollout_fragment_length=frag,
+        )
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        driver.train(num_rounds=1)  # actor+learner jit compile round
+        samples = [
+            driver.train(num_rounds=rounds)["steps_per_sec"]
+            for _ in range(TRIALS or 1)
+        ]
+    finally:
+        driver.stop()
+    return samples if TRIALS else samples[0]
+
+
+def main() -> None:
+    import os
+
+    # CPU-benchable SPMD: both layouts shard over multiple devices
+    # (anakin's mesh, sebulba's learner group), so a cpu run needs the
+    # virtual-device split tests/conftest.py uses — set BEFORE any jax
+    # backend init so the driver and every spawned worker inherit it
+    if (
+        _detect_platform() == "cpu"
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, max_workers=4 if SMOKE else 8)
+    try:
+        report("anakin_steps_per_sec", _anakin_steps_per_sec(), "steps/s")
+        report("sebulba_steps_per_sec", _sebulba_steps_per_sec(), "steps/s")
+    finally:
+        ray_tpu.shutdown()
+
+    platform = _detect_platform()
+    if platform != BASELINE_PLATFORM:
+        _multichip_stub(platform)
+
+    ratios = [r["vs_baseline"] for r in RESULTS
+              if r["vs_baseline"] and r.get("platform") == BASELINE_PLATFORM]
+    geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
+    summary = {
+        "metric": "podracer_bench_geomean_vs_baseline",
+        "value": round(geomean, 3),
+        "unit": "ratio",
+        "platform": platform,
+        "vs_baseline": round(geomean, 3),
+        "detail": {r["metric"]: r["value"] for r in RESULTS},
+    }
+    print(json.dumps(summary))
+    if JSON_PATH:
+        with open(JSON_PATH, "w") as f:
+            json.dump(
+                {
+                    "mode": "smoke" if SMOKE else ("quick" if QUICK else "full"),
+                    "trials": TRIALS or 1,
+                    "platform": platform,
+                    "metrics": {r["metric"]: r for r in RESULTS},
+                    "geomean_vs_baseline": round(geomean, 3),
+                },
+                f, indent=2,
+            )
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    _parse_argv(sys.argv[1:])
+    main()

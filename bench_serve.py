@@ -132,12 +132,19 @@ def _parse_argv(argv) -> None:
 
 
 def report(metric: str, value, unit: str) -> None:
+    from bench_core import BASELINE_PLATFORM, _detect_platform
+
     trials_list = None
     if isinstance(value, list):  # --trials mode: per-trial samples
         trials_list = [round(v, 3) for v in value]
         value = float(np.median(value))
+    platform = _detect_platform()
     base = BASELINES.get(metric)
-    if base and metric in _LOWER_IS_BETTER:
+    if platform != BASELINE_PLATFORM:
+        # BASELINES are cpu-box numbers (bench_core contract): a row
+        # measured on other hardware is stamped but never ratioed
+        ratio = None
+    elif base and metric in _LOWER_IS_BETTER:
         ratio = base / value
     elif base:
         ratio = value / base
@@ -147,6 +154,7 @@ def report(metric: str, value, unit: str) -> None:
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
+        "platform": platform,
         "vs_baseline": round(ratio, 3) if ratio else None,
     }
     if trials_list is not None:
@@ -392,12 +400,18 @@ def main() -> None:
     # measurement to prove the fault sequence is deterministic
     _bench_autoscale_chaos()
 
-    ratios = [r["vs_baseline"] for r in RESULTS if r["vs_baseline"]]
+    from bench_core import BASELINE_PLATFORM, _detect_platform
+
+    # geomean only over baseline-platform rows (off-platform rows carry
+    # vs_baseline=None by construction — same filter as bench_core)
+    ratios = [r["vs_baseline"] for r in RESULTS
+              if r["vs_baseline"] and r.get("platform") == BASELINE_PLATFORM]
     geomean = float(np.exp(np.mean(np.log(ratios)))) if ratios else 0.0
     summary = {
         "metric": "serve_bench_geomean_vs_baseline",
         "value": round(geomean, 3),
         "unit": "ratio",
+        "platform": _detect_platform(),
         "vs_baseline": round(geomean, 3),
         "detail": {r["metric"]: r["value"] for r in RESULTS},
     }
@@ -408,6 +422,7 @@ def main() -> None:
                 {
                     "mode": "smoke" if SMOKE else ("quick" if QUICK else "full"),
                     "trials": TRIALS or 1,
+                    "platform": _detect_platform(),
                     "metrics": {r["metric"]: r for r in RESULTS},
                     "geomean_vs_baseline": round(geomean, 3),
                 },
@@ -569,8 +584,20 @@ class Hit:
         time.sleep(0.005)
         return x
 
-handle = serve.run(Hit.bind())
-assert handle.remote(0).result(timeout_s=60) == 0  # warm
+# deploy + warm under the live chaos plan: the timed kill can land on
+# a replica DURING readiness (deploy is ~1s on a loaded box, the same
+# order as kill_at_s) — that is a survived fault too, so redeploy and
+# re-warm instead of dying before the measured load window opens
+for _attempt in range(5):
+    try:
+        handle = serve.run(Hit.bind())
+        assert handle.remote(0).result(timeout_s=60) == 0  # warm
+        break
+    except Exception as e:
+        print("deploy retry after:", type(e).__name__, file=sys.stderr)
+        time.sleep(0.5)
+else:
+    raise SystemExit("Hit deployment never became ready under chaos")
 stop_at = time.monotonic() + {duration_s}
 succ, total = [0], [0]
 lock = threading.Lock()

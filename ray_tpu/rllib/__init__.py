@@ -2,7 +2,8 @@
 
 Parity: python/ray/rllib/ core shape (AlgorithmConfig builder →
 Algorithm.train(); EnvRunner actor fan-out; jitted Learner update).
-PPO (sync batch) + IMPALA (async actor-learner with V-trace, §2.5).
+PPO (sync batch) + IMPALA (async actor-learner with V-trace, §2.5) +
+the Podracer layouts (Anakin/Sebulba, ``podracer/``).
 """
 
 from .algorithm import Algorithm
@@ -29,6 +30,7 @@ from .multi_agent import (
     make_multi_agent,
 )
 from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+from .podracer import PodracerConfig
 from .ppo import PPOConfig
 from .sac import SAC, SACConfig
 
@@ -57,6 +59,7 @@ __all__ = [
     "MultiAgentEnvRunner",
     "MultiAgentEpisode",
     "make_multi_agent",
+    "PodracerConfig",
     "PPOConfig",
     "SAC",
     "SACConfig",

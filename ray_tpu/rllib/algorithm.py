@@ -94,11 +94,24 @@ class Algorithm:
         ep_returns = np.concatenate(
             [ro["episode_returns"] for ro in rollouts]
         )
+        # lag-free learning signal: only the episodes that finished
+        # during this iteration's fragments (episode_return_mean is a
+        # trailing-100 window that doubles as a lifetime mean early on)
+        recent = np.concatenate(
+            [
+                ro.get("episode_returns_recent", np.zeros(0, np.float32))
+                for ro in rollouts
+            ]
+        )
         result = {
             "training_iteration": self.iteration,
             "num_env_steps_sampled_lifetime": self._timesteps,
             "episode_return_mean": float(ep_returns.mean()) if len(ep_returns) else float("nan"),
             "num_episodes": int(len(ep_returns)),
+            "episode_return_recent_mean": (
+                float(recent.mean()) if len(recent) else float("nan")
+            ),
+            "num_episodes_recent": int(len(recent)),
         }
         result.update({k: float(v) for k, v in metrics.items()})
         return result

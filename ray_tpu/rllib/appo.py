@@ -35,22 +35,12 @@ class APPOConfig(IMPALAConfig):
     build = build_algo
 
 
-def make_appo_update(config: APPOConfig, spec: MLPSpec):
-    import optax
-
-    key = (
-        config.lr, config.gamma, config.vtrace_clip_rho,
-        config.vtrace_clip_c, config.vf_loss_coeff, config.entropy_coeff,
-        config.grad_clip, config.clip_param, spec,
-    )
-    cached = _UPDATE_CACHE.get(key)
-    if cached is not None:
-        return cached
-
-    optimizer = optax.chain(
-        optax.clip_by_global_norm(config.grad_clip),
-        optax.adam(config.lr),
-    )
+def make_appo_loss(config, spec: MLPSpec):
+    """APPO's clipped-surrogate-over-V-trace loss as a standalone
+    ``loss_fn(params, batch) -> (total, metrics)``. ``config``
+    duck-types APPOConfig (adds clip_param on top of the IMPALA
+    hyperparams); reused by the Podracer learners the same way
+    ``make_impala_loss`` is."""
 
     def loss_fn(params, batch):
         logits, values = forward(params, batch["obs"])  # (T, B, A), (T, B)
@@ -87,6 +77,28 @@ def make_appo_update(config: APPOConfig, spec: MLPSpec):
             "entropy": entropy,
             "mean_ratio": jnp.mean(jax.lax.stop_gradient(ratio)),
         }
+
+    return loss_fn
+
+
+def make_appo_update(config: APPOConfig, spec: MLPSpec):
+    import optax
+
+    key = (
+        config.lr, config.gamma, config.vtrace_clip_rho,
+        config.vtrace_clip_c, config.vf_loss_coeff, config.entropy_coeff,
+        config.grad_clip, config.clip_param, spec,
+    )
+    cached = _UPDATE_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(config.grad_clip),
+        optax.adam(config.lr),
+    )
+
+    loss_fn = make_appo_loss(config, spec)
 
     @jax.jit
     def update(params, opt_state, batch):

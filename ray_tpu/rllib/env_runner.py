@@ -100,6 +100,7 @@ class SingleAgentEnvRunner:
         val_buf = np.zeros((T, N), np.float32)
 
         obs = self.obs
+        recent_returns: list = []
         for t in range(T):
             key, sub = jax.random.split(key)
             actions, logp, value = sample_actions(
@@ -145,6 +146,7 @@ class SingleAgentEnvRunner:
             self._ep_lens += 1
             for i in np.nonzero(done)[0]:
                 self.completed_returns.append(float(self._ep_returns[i]))
+                recent_returns.append(float(self._ep_returns[i]))
                 self._ep_returns[i] = 0.0
                 self._ep_lens[i] = 0
             obs = next_obs
@@ -159,4 +161,11 @@ class SingleAgentEnvRunner:
             "values": val_buf,
             "final_obs": obs.astype(np.float32),
             "episode_returns": np.asarray(stats_returns, np.float32),
+            # episodes completed during THIS fragment only. The window
+            # above is a trailing deque(maxlen=100): until 100 episodes
+            # have finished it is a LIFETIME mean that still contains
+            # the random policy's first episodes, so it lags actual
+            # learning by many iterations — short-horizon callers
+            # (tests, early-stopping) should read this key instead.
+            "episode_returns_recent": np.asarray(recent_returns, np.float32),
         }

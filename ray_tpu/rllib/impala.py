@@ -106,24 +106,14 @@ def vtrace(
 _UPDATE_CACHE: dict = {}
 
 
-def make_impala_update(config: IMPALAConfig, spec: MLPSpec):
-    """(optimizer, jitted update) — V-trace loss + one SGD step over a
-    single runner's rollout. Cached per (hyperparams, spec)."""
-    import optax
-
-    key = (
-        config.lr, config.gamma, config.vtrace_clip_rho,
-        config.vtrace_clip_c, config.vf_loss_coeff, config.entropy_coeff,
-        config.grad_clip, spec,
-    )
-    cached = _UPDATE_CACHE.get(key)
-    if cached is not None:
-        return cached
-
-    optimizer = optax.chain(
-        optax.clip_by_global_norm(config.grad_clip),
-        optax.adam(config.lr),
-    )
+def make_impala_loss(config, spec: MLPSpec):
+    """The V-trace loss as a standalone ``loss_fn(params, batch) ->
+    (total, metrics)`` over a time-major batch {obs, actions, rewards,
+    dones, logp_mu, final_obs}. ``config`` duck-types IMPALAConfig
+    (gamma/vtrace clips/vf_loss_coeff/entropy_coeff) — the Podracer
+    learners reuse this loss inside their own jitted programs (Anakin
+    inlines it into the fused superstep; Sebulba wraps it in a
+    shard_map over the learner collective mesh)."""
 
     def loss_fn(params, batch):
         T, B = batch["actions"].shape
@@ -157,6 +147,30 @@ def make_impala_update(config: IMPALAConfig, spec: MLPSpec):
                 jnp.exp(jax.lax.stop_gradient(logp) - batch["logp_mu"])
             ),
         }
+
+    return loss_fn
+
+
+def make_impala_update(config: IMPALAConfig, spec: MLPSpec):
+    """(optimizer, jitted update) — V-trace loss + one SGD step over a
+    single runner's rollout. Cached per (hyperparams, spec)."""
+    import optax
+
+    key = (
+        config.lr, config.gamma, config.vtrace_clip_rho,
+        config.vtrace_clip_c, config.vf_loss_coeff, config.entropy_coeff,
+        config.grad_clip, spec,
+    )
+    cached = _UPDATE_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(config.grad_clip),
+        optax.adam(config.lr),
+    )
+
+    loss_fn = make_impala_loss(config, spec)
 
     @jax.jit
     def update(params, opt_state, batch):

@@ -1,0 +1,301 @@
+"""Anakin: env step + learner update co-jitted into one on-chip loop.
+
+The architecture from Hessel et al. 2021 §3.1: vectorized pure-JAX
+envs and the SGD update fuse into a single XLA program (a
+``lax.scan`` over env steps feeding straight into the gradient step),
+SPMD over the ``parallel/mesh.py`` device mesh — env state shards over
+the batch axes, params replicate, and the partitioner inserts the
+gradient all-reduce. The driver never re-dispatches per step: a
+compiled-DAG resident exec loop parks on the worker, and each host
+"tick" is pure shm-channel I/O (one command array in, one metrics
+array out) covering ``anakin_supersteps_per_call`` fused supersteps.
+
+Determinism: the whole tick stream is a pure function of
+``config.seed`` (per-superstep keys are ``fold_in(seed_key, k)``), so
+a same-seed run reproduces the reward trajectory bitwise on CPU.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...util import tracing
+from .learner import make_acting_fns, make_update_fn
+
+CMD_DIM = 2  # [tick_index, reserved]
+METRICS_DIM = 10
+# metrics vector layout (float32):
+#   0 ticks_done      1 updates_total    2 env_steps_total
+#   3 ep_return_sum   4 ep_return_count  (cumulative completed episodes)
+#   5 policy_loss     6 vf_loss          7 entropy   (last superstep)
+#   8 ep_return_sum_tick  9 ep_return_count_tick  (this tick only)
+
+
+class AnakinWorker:
+    """The single resident actor: owns the mesh, the carry (params,
+    opt_state, env state) and the fused superstep program."""
+
+    def __init__(self, config_blob: bytes):
+        import jax
+
+        from ...parallel.mesh import (
+            batch_sharding,
+            dp_degree,
+            make_mesh,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        config = pickle.loads(config_blob)
+        self.config = config
+        devs = jax.devices()
+        if config.anakin_num_devices:
+            devs = devs[: config.anakin_num_devices]
+        self.mesh = make_mesh(devices=devs)
+        dp = dp_degree(self.mesh)
+        if config.num_envs % dp != 0:
+            raise ValueError(
+                f"num_envs ({config.num_envs}) must divide over the "
+                f"mesh's data-parallel degree ({dp})"
+            )
+        spec = config.spec
+        env_cls = config.env_cls
+        init_envs, act = make_acting_fns(env_cls, config.rollout_fragment_length)
+        _, update = make_update_fn(config, spec)
+
+        def superstep(carry, key):
+            params, opt_state, env_state, obs, ep_ret = carry
+            env_state, obs, ep_ret, batch, ep_sum, ep_n = act(
+                params, env_state, obs, ep_ret, key
+            )
+            params, opt_state, metrics = update(params, opt_state, batch)
+            stats = (
+                ep_sum, ep_n,
+                metrics["policy_loss"], metrics["vf_loss"],
+                metrics["entropy"],
+            )
+            return (params, opt_state, env_state, obs, ep_ret), stats
+
+        # -- build the carry with explicit SPMD placement -------------
+        from ..core import init_mlp_module
+
+        base = jax.random.PRNGKey(config.seed)
+        k_model, k_env, self._key = jax.random.split(base, 3)
+        params = init_mlp_module(k_model, spec)
+        optimizer, _ = make_update_fn(config, spec)
+        opt_state = optimizer.init(params)
+        env_state, obs, ep_ret = jax.jit(
+            init_envs, static_argnums=1
+        )(k_env, config.num_envs)
+
+        repl = NamedSharding(self.mesh, P())
+        params = jax.device_put(params, repl)
+        opt_state = jax.device_put(opt_state, repl)
+        env_state = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, batch_sharding(self.mesh, x.ndim - 1)),
+            env_state,
+        )
+        obs = jax.device_put(obs, batch_sharding(self.mesh, obs.ndim - 1))
+        ep_ret = jax.device_put(ep_ret, batch_sharding(self.mesh, 0))
+        self._carry = (params, opt_state, env_state, obs, ep_ret)
+
+        # AOT-compile against the real carry so the resident loop's
+        # first tick never pays the trace+lower cost, and so the split
+        # trace-mode programs share placement with the fused one.
+        key0 = jax.device_put(jax.random.fold_in(self._key, 0), repl)
+        self._superstep = (
+            jax.jit(superstep).lower(self._carry, key0).compile()
+        )
+        self._act = (
+            jax.jit(act)
+            .lower(params, env_state, obs, ep_ret, key0)
+            .compile()
+        )
+        self._update = None  # lazily compiled on first traced tick
+        self._update_fn = update
+        self._jax = jax
+        self._repl = repl
+        self._supersteps = 0
+        self._ticks = 0
+        self._ep_sum = 0.0
+        self._ep_n = 0.0
+        self._last_losses = (0.0, 0.0, 0.0)
+        self._steps_per_superstep = (
+            config.rollout_fragment_length * config.num_envs
+        )
+
+    def ready(self) -> bool:
+        return True
+
+    def _next_key(self):
+        key = self._jax.random.fold_in(self._key, self._supersteps)
+        return self._jax.device_put(key, self._repl)
+
+    def _fold_stats(self, stats):
+        ep_sum, ep_n, pi_l, vf_l, ent = (float(s) for s in stats)
+        self._last_losses = (pi_l, vf_l, ent)
+        return ep_sum, ep_n
+
+    def _tick_fused(self, n: int):
+        tick_sum = tick_n = 0.0
+        for _ in range(n):
+            self._carry, stats = self._superstep(self._carry, self._next_key())
+            self._supersteps += 1
+            s, c = self._fold_stats(stats)
+            tick_sum += s
+            tick_n += c
+        return tick_sum, tick_n
+
+    def _tick_traced(self, n: int):
+        """Trace mode: the acting scan and the update run as two jitted
+        programs so each gets its own span — the fused program can't
+        be split from the outside. Slower than fused; only taken when
+        tracing is live."""
+        jax = self._jax
+        tick_sum = tick_n = 0.0
+        for _ in range(n):
+            params, opt_state, env_state, obs, ep_ret = self._carry
+            with tracing.span(
+                "podracer.env_step", stage="podracer.env_step", mode="anakin"
+            ):
+                env_state, obs, ep_ret, batch, ep_sum, ep_n = self._act(
+                    params, env_state, obs, ep_ret, self._next_key()
+                )
+                jax.block_until_ready(batch)
+            with tracing.span(
+                "podracer.learner_update",
+                stage="podracer.learner_update",
+                mode="anakin",
+            ):
+                if self._update is None:
+                    self._update = (
+                        jax.jit(self._update_fn)
+                        .lower(params, opt_state, batch)
+                        .compile()
+                    )
+                params, opt_state, metrics = self._update(
+                    params, opt_state, batch
+                )
+                jax.block_until_ready(params)
+            self._carry = (params, opt_state, env_state, obs, ep_ret)
+            self._supersteps += 1
+            s, c = self._fold_stats((
+                ep_sum, ep_n,
+                metrics["policy_loss"], metrics["vf_loss"],
+                metrics["entropy"],
+            ))
+            tick_sum += s
+            tick_n += c
+        return tick_sum, tick_n
+
+    def tick(self, cmd: np.ndarray) -> np.ndarray:
+        """One resident-loop turn: run ``anakin_supersteps_per_call``
+        fused supersteps, return the fixed-shape metrics vector."""
+        n = self.config.anakin_supersteps_per_call
+        if tracing.is_enabled():
+            tick_sum, tick_n = self._tick_traced(n)
+        else:
+            tick_sum, tick_n = self._tick_fused(n)
+        self._ep_sum += tick_sum
+        self._ep_n += tick_n
+        pi_l, vf_l, ent = self._last_losses
+        self._ticks += 1
+        return np.array(
+            [
+                self._ticks,
+                self._supersteps,
+                self._supersteps * self._steps_per_superstep,
+                self._ep_sum,
+                self._ep_n,
+                pi_l,
+                vf_l,
+                ent,
+                tick_sum,
+                tick_n,
+            ],
+            dtype=np.float32,
+        )
+
+
+class AnakinDriver:
+    """Drives the resident AnakinWorker through a channel-compiled DAG:
+    ``train(n)`` is n shm ring-buffer round trips, zero scheduler
+    round trips after compile."""
+
+    def __init__(self, config):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        self._ray = ray_tpu
+        self.config = config
+        blob = pickle.dumps(config)
+        worker_cls = ray_tpu.remote(AnakinWorker)
+        self._worker = worker_cls.remote(blob)
+        ray_tpu.get(self._worker.ready.remote(), timeout=300)
+        self._compiled = None
+        if config.use_compiled_dag:
+            from ...dag import InputNode
+
+            with InputNode() as inp:
+                dag = self._worker.tick.bind(
+                    inp.with_shm_channel((CMD_DIM,), "float32")
+                ).with_shm_channel((METRICS_DIM,), "float32")
+            self._compiled = dag.experimental_compile(
+                max_inflight_executions=2
+            )
+        self._tick_idx = 0
+        self._env_steps_seen = 0.0
+
+    def _tick(self, timeout: float = 300.0) -> np.ndarray:
+        cmd = np.array([self._tick_idx, 0], dtype=np.float32)
+        self._tick_idx += 1
+        if self._compiled is not None:
+            return self._compiled.execute(cmd).get(timeout=timeout)
+        return self._ray.get(self._worker.tick.remote(cmd), timeout=timeout)
+
+    def train(self, num_ticks: int) -> Dict[str, Any]:
+        """Run ``num_ticks`` resident-loop turns; returns aggregate
+        throughput plus the per-tick reward trajectory (bitwise
+        reproducible for a given seed on CPU)."""
+        rows: List[np.ndarray] = []
+        t0 = time.perf_counter()
+        for _ in range(num_ticks):
+            rows.append(self._tick())
+        elapsed = time.perf_counter() - t0
+        last = rows[-1]
+        env_steps = float(last[2]) - self._env_steps_seen
+        self._env_steps_seen = float(last[2])
+        trajectory = [
+            (float(r[8] / r[9]) if r[9] > 0 else float("nan")) for r in rows
+        ]
+        return {
+            "mode": "anakin",
+            "ticks": int(last[0]),
+            "updates": int(last[1]),
+            "env_steps_total": int(last[2]),
+            "env_steps": int(env_steps),
+            "time_s": elapsed,
+            "steps_per_sec": env_steps / elapsed if elapsed > 0 else 0.0,
+            "episode_return_mean": (
+                float(last[3] / last[4]) if last[4] > 0 else float("nan")
+            ),
+            "num_episodes": int(last[4]),
+            "policy_loss": float(last[5]),
+            "vf_loss": float(last[6]),
+            "entropy": float(last[7]),
+            "reward_trajectory": trajectory,
+            "metrics_rows": np.stack(rows),
+        }
+
+    def stop(self) -> None:
+        if self._compiled is not None:
+            self._compiled.teardown()
+            self._compiled = None
+        try:
+            self._ray.kill(self._worker)
+        except Exception:
+            pass

@@ -1,0 +1,109 @@
+"""Pure-JAX vectorized environments for the Podracer loops.
+
+Anakin needs the environment step INSIDE the jitted program (the whole
+point of the architecture: env + learner fused into one XLA
+executable), so gymnasium's process-bound envs can't be used there.
+This module provides jit-compatible env dynamics with the same
+observation/action contract as ``env_runner.SingleAgentEnvRunner`` —
+an env is a class of pure functions over an explicit state pytree:
+
+    reset(key)              -> (state, obs)
+    step(state, action, key) -> (state, obs, reward, done)
+
+Auto-reset is folded into ``step`` (SAME_STEP semantics, mirroring the
+gymnasium vector path): when an episode ends, ``done=1`` is returned
+together with the freshly-reset observation, so a ``lax.scan`` over
+steps never leaves the program. The bootstrap value of a reset obs is
+masked by ``1 - done`` inside V-trace, so the swap is sound.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+import jax
+import jax.numpy as jnp
+
+
+class JaxCartPole:
+    """CartPole-v1 dynamics (Barto, Sutton & Anderson 1983) as pure
+    JAX — numerically the same Euler integration and thresholds as
+    ``gymnasium/envs/classic_control/cartpole.py``, including the
+    500-step time limit (treated as ``done``)."""
+
+    obs_dim = 4
+    num_actions = 2
+    max_steps = 500
+
+    _GRAVITY = 9.8
+    _MASSCART = 1.0
+    _MASSPOLE = 0.1
+    _TOTAL_MASS = _MASSPOLE + _MASSCART
+    _LENGTH = 0.5  # half the pole's length
+    _POLEMASS_LENGTH = _MASSPOLE * _LENGTH
+    _FORCE_MAG = 10.0
+    _TAU = 0.02
+    _THETA_THRESHOLD = 12 * 2 * jnp.pi / 360
+    _X_THRESHOLD = 2.4
+
+    @classmethod
+    def reset(cls, key):
+        phys = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        state = {"phys": phys, "t": jnp.zeros((), jnp.int32)}
+        return state, phys.astype(jnp.float32)
+
+    @classmethod
+    def step(cls, state, action, key):
+        x, x_dot, theta, theta_dot = state["phys"]
+        force = jnp.where(action == 1, cls._FORCE_MAG, -cls._FORCE_MAG)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        temp = (
+            force + cls._POLEMASS_LENGTH * theta_dot**2 * sintheta
+        ) / cls._TOTAL_MASS
+        thetaacc = (cls._GRAVITY * sintheta - costheta * temp) / (
+            cls._LENGTH
+            * (4.0 / 3.0 - cls._MASSPOLE * costheta**2 / cls._TOTAL_MASS)
+        )
+        xacc = temp - cls._POLEMASS_LENGTH * thetaacc * costheta / cls._TOTAL_MASS
+        x = x + cls._TAU * x_dot
+        x_dot = x_dot + cls._TAU * xacc
+        theta = theta + cls._TAU * theta_dot
+        theta_dot = theta_dot + cls._TAU * thetaacc
+        phys = jnp.stack([x, x_dot, theta, theta_dot])
+        t = state["t"] + 1
+
+        terminated = (
+            (jnp.abs(x) > cls._X_THRESHOLD)
+            | (jnp.abs(theta) > cls._THETA_THRESHOLD)
+        )
+        done = terminated | (t >= cls.max_steps)
+        reward = jnp.float32(1.0)
+
+        # SAME_STEP auto-reset: the returned obs after a done step is
+        # the next episode's first obs; V-trace masks its bootstrap.
+        reset_state, reset_obs = cls.reset(key)
+        next_state = {
+            "phys": jnp.where(done, reset_state["phys"], phys),
+            "t": jnp.where(done, reset_state["t"], t),
+        }
+        obs = jnp.where(done, reset_obs, phys).astype(jnp.float32)
+        return next_state, obs, reward, done.astype(jnp.float32)
+
+
+JAX_ENVS: Dict[str, Type] = {"CartPole-v1": JaxCartPole}
+
+
+def register_jax_env(name: str, env_cls) -> None:
+    """Register a jittable env under ``name`` for PodracerConfig.env."""
+    JAX_ENVS[name] = env_cls
+
+
+def get_jax_env(name: str):
+    try:
+        return JAX_ENVS[name]
+    except KeyError:
+        raise ValueError(
+            f"no pure-JAX env registered for {name!r} (have "
+            f"{sorted(JAX_ENVS)}); register one with register_jax_env()"
+        ) from None

@@ -1,0 +1,394 @@
+"""Sebulba: actor/learner split across slices (Hessel et al. 2021 §3.2).
+
+Topology: ``num_actors`` rollout workers and one learner worker,
+gang-placed in two placement groups (SLICE strategy when TPU chips are
+present, PACK on CPU). Every data-plane hop rides the cheapest path
+the runtime has:
+
+- **actor fan-out** — each round's ``sample_fragment`` tasks go out as
+  ONE ``fn.map`` SUBMIT_TASKS frame (bulk submission);
+- **trajectory hand-off** — each fragment returns a >=100KiB rollout
+  batch, which the result path encodes as a shm segment (VAL_SHM):
+  only the segment *name* crosses the hub, the learner pulls bytes
+  over the direct object plane — zero hub relay for rollout payloads;
+- **learner all-reduce** — gradients ``psum`` over a cached jitted
+  collective group (``util.collective`` XlaGroup mesh);
+- **param broadcast** — the learner publishes ``(version, params)`` on
+  a version-tagged KV channel; actors poll it at fragment start and
+  cache by version, so a stale learner never wedges the actor loop.
+
+Fault model: the learner update is a *plain task* — a chaos
+``worker_kill`` mid-update is survived by lineage retry (same input
+state ref + same trajectory refs -> identical recomputed output), so
+the step counter resumes monotonically from the last published state
+and actors keep sampling against the last KV version throughout.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ...util import tracing
+from ...util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+# one process-wide cache per worker: compiled acting programs, the
+# learner's collective group, and the last fetched param version
+_PROC_CACHE: Dict[Any, Any] = {}
+
+
+def _kv_key(namespace: str) -> bytes:
+    return f"podracer/{namespace}/params".encode()
+
+
+def _acting_programs(config):
+    """Per-process jitted acting programs, keyed by what changes their
+    XLA program (env, fragment length, net shape)."""
+    import jax
+
+    from .learner import make_acting_fns
+
+    key = ("act", config.env, config.rollout_fragment_length,
+           tuple(config.hiddens))
+    progs = _PROC_CACHE.get(key)
+    if progs is None:
+        init_envs, act = make_acting_fns(
+            config.env_cls, config.rollout_fragment_length
+        )
+        progs = (jax.jit(init_envs, static_argnums=1), jax.jit(act))
+        _PROC_CACHE[key] = progs
+    return progs
+
+
+def _fetch_params(client, config):
+    """Actor-side half of the version-tagged param channel: read the
+    KV blob, decode only on version change."""
+    key = ("params", config.namespace)
+    blob = client.kv_get(_kv_key(config.namespace))
+    if blob is None:
+        raise RuntimeError(
+            f"no published params on {_kv_key(config.namespace)!r} — "
+            "SebulbaDriver publishes version 0 before the first round"
+        )
+    version, params = pickle.loads(blob)
+    cached = _PROC_CACHE.get(key)
+    if cached is not None and cached[0] == version:
+        return cached
+    _PROC_CACHE[key] = (version, params)
+    return version, params
+
+
+@ray_tpu.remote
+def sample_fragment(cfg_blob: bytes, actor_idx: int, round_idx: int, carry):
+    """One actor's rollout fragment. Pure function of (config, carry,
+    published params): chaos-killed instances replay losslessly via
+    lineage. Returns ``(traj, carry')`` via num_returns=2 — ``traj``
+    is the big time-major batch (rides the object plane), ``carry'``
+    the small env-state continuation the driver threads forward."""
+    import jax
+
+    from ray_tpu._private import worker
+
+    config = pickle.loads(cfg_blob)
+    client = worker.get_client()
+
+    with tracing.span(
+        "podracer.param_sync", stage="podracer.param_sync",
+        role="actor", actor=actor_idx,
+    ):
+        version, params = _fetch_params(client, config)
+
+    init_envs, act = _acting_programs(config)
+    with tracing.span(
+        "podracer.env_step", stage="podracer.env_step",
+        role="actor", actor=actor_idx, round=round_idx,
+    ):
+        if carry is None:
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(config.seed), 7919 + actor_idx
+            )
+            env_state, obs, ep_ret = init_envs(key, config.envs_per_actor)
+        else:
+            env_state = carry["env_state"]
+            obs = carry["obs"]
+            ep_ret = carry["ep_ret"]
+        frag_key = jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.PRNGKey(config.seed), 104729 + actor_idx
+            ),
+            round_idx,
+        )
+        env_state, obs, ep_ret, batch, ep_sum, ep_n = act(
+            params, env_state, obs, ep_ret, frag_key
+        )
+        jax.block_until_ready(batch)
+
+    traj = {k: np.asarray(v) for k, v in batch.items()}
+    traj["behavior_version"] = version
+    new_carry = {
+        "env_state": jax.tree_util.tree_map(np.asarray, env_state),
+        "obs": np.asarray(obs),
+        "ep_ret": np.asarray(ep_ret),
+        "ep_sum": float(ep_sum),
+        "ep_n": float(ep_n),
+        "behavior_version": version,
+    }
+    return traj, new_carry
+
+
+def _learner_group(config):
+    key = ("group", config.namespace, config.learner_shards)
+    group = _PROC_CACHE.get(key)
+    if group is None:
+        from ...util.collective.collective_group.xla_group import XlaGroup
+
+        group = XlaGroup(
+            config.learner_shards, 0, f"podracer-{config.namespace}"
+        )
+        _PROC_CACHE[key] = group
+    return group
+
+
+@ray_tpu.remote
+def learner_update(cfg_blob: bytes, state, *trajs):
+    """One learner round: ingest the handed-off fragments, run
+    ``num_sgd_steps`` sharded updates (grad all-reduce over the
+    collective group), publish params on the KV channel every
+    ``param_sync_interval`` steps. Pure function of (state, trajs) —
+    the KV publish is idempotent per version, so lineage retry after a
+    worker_kill republishes the same bytes and resumes the counter."""
+    import jax
+
+    from ray_tpu._private import worker
+
+    from .learner import make_sharded_update
+
+    config = pickle.loads(cfg_blob)
+    client = worker.get_client()
+    spec = config.spec
+
+    with tracing.span(
+        "podracer.traj_handoff", stage="podracer.traj_handoff",
+        fragments=len(trajs),
+        bytes=sum(sum(a.nbytes for a in t.values()
+                      if isinstance(a, np.ndarray)) for t in trajs),
+    ):
+        batch = {
+            k: np.concatenate(
+                [t[k] for t in trajs], axis=0 if k == "final_obs" else 1
+            )
+            for k in ("obs", "actions", "rewards", "dones", "logp_mu",
+                      "final_obs")
+        }
+        batch = {k: jax.device_put(v) for k, v in batch.items()}
+
+    group = _learner_group(config)
+    _, update = make_sharded_update(config, spec, group)
+    params = state["params"]
+    opt_state = state["opt_state"]
+    with tracing.span(
+        "podracer.learner_update", stage="podracer.learner_update",
+        step=state["step"] + 1, shards=group.world_size,
+    ):
+        for _ in range(config.num_sgd_steps):
+            params, opt_state, metrics = update(params, opt_state, batch)
+        jax.block_until_ready(params)
+
+    step = state["step"] + 1
+    version = state["version"]
+    host_params = jax.tree_util.tree_map(np.asarray, params)
+    new_state = {
+        "params": host_params,
+        "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
+        "step": step,
+        "version": version,
+    }
+    if step % config.param_sync_interval == 0:
+        version = step
+        new_state["version"] = version
+        with tracing.span(
+            "podracer.param_sync", stage="podracer.param_sync",
+            role="learner", version=version,
+        ):
+            client.kv_put(
+                _kv_key(config.namespace),
+                pickle.dumps((version, host_params)),
+            )
+    out_metrics = {
+        "step": step,
+        "version": version,
+        "behavior_versions": sorted(
+            {int(t.get("behavior_version", -1)) for t in trajs}
+        ),
+        **{k: float(v) for k, v in metrics.items()},
+    }
+    return new_state, out_metrics
+
+
+class SebulbaDriver:
+    """Round-based driver: each round is one bulk-submitted actor
+    fan-out plus one learner task chained on the state ref. Up to
+    ``max_inflight_rounds`` learner rounds run behind the actors —
+    the Sebulba decoupling: actors never block on the learner (params
+    arrive via the KV channel), the driver never touches rollout
+    bytes (they flow actor -> object plane -> learner by reference).
+    """
+
+    def __init__(self, config):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(ignore_reinit_error=True)
+        config.validate()
+        self.config = config
+        self._cfg_blob = pickle.dumps(config)
+
+        # gang placement: actors and learner on separate slices when
+        # chips are present; on CPU hosts both degrade to PACK over
+        # CPU bundles (resource reservation on a single host).
+        strategy = config.placement_strategy
+        if strategy is None:
+            cluster = ray_tpu.cluster_resources()
+            strategy = "SLICE" if cluster.get("TPU", 0) >= 1 else "PACK"
+        bundle = {"TPU": 1} if strategy == "SLICE" else {"CPU": 1}
+        from ...util.placement_group import placement_group
+
+        self._pg_actors = placement_group(
+            [dict(bundle) for _ in range(config.num_actors)],
+            strategy=strategy, name="podracer-actors",
+        )
+        self._pg_learner = placement_group(
+            [dict(bundle)], strategy=strategy, name="podracer-learner",
+        )
+        if not (self._pg_actors.wait(60) and self._pg_learner.wait(60)):
+            raise RuntimeError("Podracer placement groups failed to place")
+
+        self._sample = sample_fragment.options(
+            num_returns=2,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                self._pg_actors, -1
+            ),
+        )
+        self._learn = learner_update.options(
+            num_returns=2,
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                self._pg_learner, 0
+            ),
+        )
+
+        # initial state: version 0 published before the first round so
+        # the actor loop can always make progress
+        import jax
+
+        from ..core import init_mlp_module
+        from .learner import make_optimizer
+
+        params = init_mlp_module(
+            jax.random.PRNGKey(config.seed), config.spec
+        )
+        opt_state = make_optimizer(config).init(params)
+        host_params = jax.tree_util.tree_map(np.asarray, params)
+        state = {
+            "params": host_params,
+            "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
+            "step": 0,
+            "version": 0,
+        }
+        from ray_tpu._private import worker as _worker
+
+        _worker.get_client().kv_put(
+            _kv_key(config.namespace),
+            pickle.dumps((0, host_params)),
+        )
+        self._state_ref = ray_tpu.put(state)
+        self._carries: List[Optional[dict]] = [None] * config.num_actors
+        self._round = 0
+        self._ep_sum = 0.0
+        self._ep_n = 0.0
+        self._last_metrics: Dict[str, Any] = {"step": 0, "version": 0}
+
+    # -- round machinery ----------------------------------------------
+    def _submit_round(self, inflight: deque) -> None:
+        items = [
+            (self._cfg_blob, i, self._round, self._carries[i])
+            for i in range(self.config.num_actors)
+        ]
+        rows = self._sample.map(items)
+        traj_refs = [row[0] for row in rows]
+        carry_refs = [row[1] for row in rows]
+        self._state_ref, metrics_ref = self._learn.remote(
+            self._cfg_blob, self._state_ref, *traj_refs
+        )
+        # hold the traj refs until the learner round is harvested so
+        # the segments can't be freed under an in-flight (or chaos-
+        # retried) learner task
+        inflight.append((metrics_ref, traj_refs))
+        self._round += 1
+
+        # the actors' small continuations: fetched eagerly (they gate
+        # the next round anyway), harvested for episode stats
+        carries = ray_tpu.get(carry_refs, timeout=300)
+        self._carries = list(carries)
+        for c in carries:
+            self._ep_sum += c["ep_sum"]
+            self._ep_n += c["ep_n"]
+
+    def _harvest_one(self, inflight: deque) -> Dict[str, Any]:
+        metrics_ref, _traj_refs = inflight.popleft()
+        metrics = ray_tpu.get(metrics_ref, timeout=300)
+        self._last_metrics = metrics
+        return metrics
+
+    def train(self, num_rounds: int) -> Dict[str, Any]:
+        """Run ``num_rounds`` actor->learner rounds; returns throughput
+        and learning stats. Actor rounds pipeline up to
+        ``max_inflight_rounds`` ahead of the learner chain."""
+        cfg = self.config
+        inflight: deque = deque()
+        learner_steps: List[int] = []
+        round_returns: List[float] = []
+        t0 = time.perf_counter()
+        for _ in range(num_rounds):
+            before_n, before_sum = self._ep_n, self._ep_sum
+            self._submit_round(inflight)
+            dn = self._ep_n - before_n
+            round_returns.append(
+                (self._ep_sum - before_sum) / dn if dn > 0 else float("nan")
+            )
+            while len(inflight) > cfg.max_inflight_rounds:
+                learner_steps.append(self._harvest_one(inflight)["step"])
+        while inflight:
+            learner_steps.append(self._harvest_one(inflight)["step"])
+        elapsed = time.perf_counter() - t0
+        env_steps = (
+            num_rounds * cfg.num_actors * cfg.envs_per_actor
+            * cfg.rollout_fragment_length
+        )
+        return {
+            "mode": "sebulba",
+            "rounds": num_rounds,
+            "env_steps": env_steps,
+            "time_s": elapsed,
+            "steps_per_sec": env_steps / elapsed if elapsed > 0 else 0.0,
+            "learner_steps": learner_steps,
+            "learner_step": self._last_metrics.get("step", 0),
+            "param_version": self._last_metrics.get("version", 0),
+            "episode_return_mean": (
+                self._ep_sum / self._ep_n if self._ep_n > 0 else float("nan")
+            ),
+            "num_episodes": int(self._ep_n),
+            "reward_trajectory": round_returns,
+            "learner_metrics": dict(self._last_metrics),
+        }
+
+    def stop(self) -> None:
+        from ...util.placement_group import remove_placement_group
+
+        for pg in (self._pg_actors, self._pg_learner):
+            try:
+                remove_placement_group(pg)
+            except Exception:
+                pass

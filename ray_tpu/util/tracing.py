@@ -293,6 +293,21 @@ STAGE_PRECEDENCE: Dict[str, int] = {
     # charge their park correctly.
     "serve.payload_put": 38,     # handle: spill request body to object plane
     "serve.payload_fetch": 72,   # replica: bulk-resolve payload refs
+    # ---- Podracer RL loops (rllib/podracer). These are user-level
+    # spans emitted inside the actor/learner task bodies, so they sit
+    # ABOVE worker execute (60): within a Podracer task the RL phase is
+    # the more specific name for the slice. env_step (the acting scan)
+    # vs learner_update (the SGD step) is the question analyze_trace
+    # answers — actor-bound or learner-bound. traj_handoff (learner-
+    # side ingestion of handed-off fragments) and param_sync (actor-
+    # side KV fetch / learner-side KV publish) name the cross-slice
+    # coupling costs; they sit above env_step/learner_update because
+    # both occur as narrower phases inside the same task bodies and
+    # must not be double-charged to the enclosing RL phase.
+    "podracer.env_step": 71,
+    "podracer.learner_update": 71,
+    "podracer.traj_handoff": 74,
+    "podracer.param_sync": 74,
 }
 
 

@@ -1,0 +1,88 @@
+"""bench_podracer.py harness smoke test (tier-1 safe, not marked slow).
+
+Mirrors tests/test_bench_harness.py for the Podracer rows: one --smoke
+micro-iteration end to end, asserting the --json report covers every
+BASELINES metric with the platform-stamp/ratio-refusal contract —
+numbers are NOT checked (smoke counts are sized for latency).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench_podracer.py")
+
+
+def test_smoke_run_reports_every_baseline_metric(tmp_path):
+    out_path = tmp_path / "bench.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+    r = subprocess.run(
+        [sys.executable, BENCH, "--smoke", "--trials", "2",
+         "--json", str(out_path)],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=420,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+    data = json.loads(out_path.read_text())
+    assert data["mode"] == "smoke"
+    assert data["trials"] == 2
+
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from bench_podracer import BASELINE_PLATFORM, BASELINES
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    missing = set(BASELINES) - set(data["metrics"])
+    assert not missing, f"BASELINES metrics missing from report: {missing}"
+
+    assert data["platform"] == BASELINE_PLATFORM  # JAX_PLATFORMS=cpu above
+    for name, rec in data["metrics"].items():
+        assert rec.get("platform"), f"{name} row missing platform stamp"
+        if rec["platform"] != BASELINE_PLATFORM:
+            assert rec["vs_baseline"] is None, name
+        elif name in BASELINES:
+            assert rec["vs_baseline"] is not None, name
+        assert rec["value"] > 0, f"{name} reported a non-positive value"
+        trials = rec.get("trials")
+        assert trials is not None and len(trials) == 2, name
+        assert (
+            min(trials) - 0.01 <= rec["value"] <= max(trials) + 0.01
+        ), (name, rec["value"], trials)
+
+    # every stdout metric line is one JSON object (the scrapeable form)
+    parsed = [
+        json.loads(line) for line in r.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    assert {p["metric"] for p in parsed} >= set(BASELINES)
+
+
+def test_report_refuses_cross_platform_ratio(monkeypatch):
+    """A Podracer row measured on non-baseline hardware keeps its
+    platform stamp and has vs_baseline refused — cpu-box steps/s are
+    not comparable to MULTICHIP numbers (bench_podracer docstring)."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench_podracer
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+    monkeypatch.setattr(bench_podracer, "RESULTS", [])
+    monkeypatch.setattr(bench_podracer, "_detect_platform", lambda: "tpu")
+    bench_podracer.report("anakin_steps_per_sec", 12345.0, "steps/s")
+    rec = bench_podracer.RESULTS[-1]
+    assert rec["platform"] == "tpu"
+    assert rec["vs_baseline"] is None
+
+    monkeypatch.setattr(
+        bench_podracer, "_detect_platform",
+        lambda: bench_podracer.BASELINE_PLATFORM,
+    )
+    bench_podracer.report("sebulba_steps_per_sec", 12345.0, "steps/s")
+    rec = bench_podracer.RESULTS[-1]
+    assert rec["platform"] == bench_podracer.BASELINE_PLATFORM
+    assert rec["vs_baseline"] is not None

@@ -47,6 +47,16 @@ def test_smoke_run_reports_every_serve_baseline_metric(tmp_path):
 
     missing = set(BASELINES) - set(data["metrics"])
     assert not missing, f"BASELINES metrics missing from report: {missing}"
+    # platform stamping (PR 20): the run-level platform plus one stamp
+    # per row, same contract as bench_core — BASELINES are cpu-box
+    # numbers, so off-platform rows must carry vs_baseline=None
+    from bench_core import BASELINE_PLATFORM
+
+    assert data["platform"] == BASELINE_PLATFORM  # JAX_PLATFORMS=cpu above
+    for name, rec in data["metrics"].items():
+        assert rec.get("platform"), f"{name} row missing platform stamp"
+        if rec["platform"] != BASELINE_PLATFORM:
+            assert rec["vs_baseline"] is None, name
     for name, rec in data["metrics"].items():
         assert rec["value"] > 0, f"{name} reported a non-positive value"
     # efficiency and success-rate rows are ratios in (0, 1]

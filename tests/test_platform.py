@@ -478,6 +478,10 @@ def test_workflow_parallel_branches(ray_start_4_cpus, tmp_path):
     with InputNode() as inp:
         dag = join.bind(branch.bind(inp, "l"), branch.bind(inp, "r"))
 
+    # warm two workers first: a cold spawn costs ~0.5-1.5s on this box
+    # and would masquerade as serialization in the wall-time bound below
+    ray_tpu.get([branch.remote(0, "warm_a"), branch.remote(0, "warm_b")])
+
     t0 = time.monotonic()
     (ltag, _), (rtag, _) = workflow.run(dag, workflow_id="wfp", args=0)
     elapsed = time.monotonic() - t0

@@ -33,13 +33,25 @@ def test_ppo_learns_cartpole(algo, tmp_path):
     assert result["num_env_steps_sampled_lifetime"] == 2 * 2 * 64
     assert np.isfinite(result["policy_loss"])
     assert np.isfinite(result["vf_loss"])
-    first = last = result["episode_return_mean"] if result["num_episodes"] else None
+    # Learning is asserted on episode_return_RECENT_mean (episodes that
+    # finished during the iteration), not episode_return_mean: the
+    # latter is a trailing deque(maxlen=100) which, at this test's
+    # budget (~6k steps, <100 episodes completed), is still a LIFETIME
+    # mean containing the seed's random-policy episodes — at iteration
+    # 12 it reads ~39 while episodes actually being completed average
+    # ~90+, so a "+20 over first" bar on the window is structurally
+    # unreachable even though PPO is learning fine (it reaches ~72 by
+    # iteration 30 and keeps climbing).
+    first = last = (
+        result["episode_return_recent_mean"]
+        if result["num_episodes_recent"] else None
+    )
     for i in range(11):
         r = algo.train()
-        if first is None and r["num_episodes"] > 0:
-            first = r["episode_return_mean"]
-        if r["num_episodes"] > 0:
-            last = r["episode_return_mean"]
+        if first is None and r["num_episodes_recent"] > 0:
+            first = r["episode_return_recent_mean"]
+        if r["num_episodes_recent"] > 0:
+            last = r["episode_return_recent_mean"]
     assert first is not None and last is not None
     # CartPole random policy ~20; after ~6k steps PPO should be well up
     assert last > first + 20, (first, last)
@@ -215,6 +227,7 @@ def test_bc_offline_from_dataset(ray_start_4_cpus):
     assert (preds == truth).mean() > 0.9
 
 
+@pytest.mark.slow  # ~43s convergence run, the suite's single biggest row
 def test_sac_learns_pendulum(ray_start_4_cpus):
     """Continuous-control convergence: twin-critic max-entropy SAC on
     Pendulum (reference: sac tuned_examples bar)."""

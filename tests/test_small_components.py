@@ -56,7 +56,8 @@ def test_widgets_html_reprs(ray_start_regular):
     assert "Dataset" in html and "plan" in html
 
 
-def test_rpdb_breakpoint_attach(ray_start_regular):
+@pytest.mark.slow  # interactive-debugger attach: ~32s of connect/poll
+def test_rpdb_breakpoint_attach(ray_start_regular):  # waits in this sandbox
     from ray_tpu.util import rpdb
 
     @ray_tpu.remote
